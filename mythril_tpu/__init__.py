@@ -1,0 +1,10 @@
+"""mythril_tpu: a TPU-native EVM symbolic-execution security analyzer.
+
+A from-scratch rebuild of the capabilities of Mythril (the reference at
+/root/reference) designed for TPU hardware: a vmapped/batched symbolic EVM
+interpreter over structure-of-arrays state in HBM, an in-repo SMT stack
+(term DAG -> bit-blasting -> C++ CDCL / JAX batched search; no z3), and
+pjit/shard_map multi-chip scaling for path-parallel exploration.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from mythril_tpu.smt.solver.solver import (
+    BaseSolver,
+    CheckResult,
+    Optimize,
+    Solver,
+    sat,
+    unknown,
+    unsat,
+)
+from mythril_tpu.smt.solver.independence_solver import IndependenceSolver
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics

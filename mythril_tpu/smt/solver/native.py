@@ -1,0 +1,95 @@
+"""ctypes wrapper exposing the C++ CDCL solver with the PySat interface."""
+
+import ctypes
+from typing import Iterable, List, Optional
+
+from mythril_tpu.smt.solver import pysat
+from mythril_tpu.support.native_build import load_native_lib
+
+SAT = pysat.SAT
+UNSAT = pysat.UNSAT
+UNKNOWN = pysat.UNKNOWN
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = load_native_lib()
+    if lib is not None and not _configured:
+        lib.tsat_new.restype = ctypes.c_void_p
+        lib.tsat_free.argtypes = [ctypes.c_void_p]
+        lib.tsat_new_var.argtypes = [ctypes.c_void_p]
+        lib.tsat_new_var.restype = ctypes.c_int
+        lib.tsat_add_clause.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        lib.tsat_solve.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_longlong,
+        ]
+        lib.tsat_solve.restype = ctypes.c_int
+        lib.tsat_model_value.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tsat_model_value.restype = ctypes.c_int
+        lib.tsat_ok.argtypes = [ctypes.c_void_p]
+        lib.tsat_ok.restype = ctypes.c_int
+        _configured = True
+    return lib
+
+
+class NativeSat:
+    """Same interface as pysat.PySat, backed by csrc/native.cpp."""
+
+    def __init__(self) -> None:
+        self._lib = _lib()
+        if self._lib is None:
+            raise RuntimeError("native solver unavailable")
+        self._s = self._lib.tsat_new()
+
+    def __del__(self):
+        try:
+            if getattr(self, "_s", None):
+                self._lib.tsat_free(self._s)
+                self._s = None
+        except Exception:
+            pass
+
+    def new_var(self) -> int:
+        return self._lib.tsat_new_var(self._s)
+
+    def add_clause(self, lits: Iterable[int]) -> None:
+        arr = list(lits)
+        buf = (ctypes.c_int * len(arr))(*arr)
+        self._lib.tsat_add_clause(self._s, buf, len(arr))
+
+    def solve(
+        self,
+        assumptions: Optional[List[int]] = None,
+        timeout_ms: Optional[int] = None,
+        conflict_budget: Optional[int] = None,
+    ) -> int:
+        arr = list(assumptions or [])
+        buf = (ctypes.c_int * len(arr))(*arr)
+        return self._lib.tsat_solve(
+            self._s, buf, len(arr), timeout_ms or 0, conflict_budget or 0
+        )
+
+    def model_value(self, var: int) -> int:
+        return self._lib.tsat_model_value(self._s, var)
+
+    @property
+    def ok(self) -> bool:
+        return bool(self._lib.tsat_ok(self._s))
+
+
+def make_sat():
+    """Preferred SAT engine: native C++, falling back to pure Python."""
+    try:
+        return NativeSat()
+    except (RuntimeError, OSError):
+        return pysat.PySat()

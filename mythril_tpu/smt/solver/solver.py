@@ -1,0 +1,258 @@
+"""Solver facade (reference surface: mythril/laser/smt/solver/solver.py).
+
+check() runs the full in-repo pipeline: theory elimination (preprocess.py)
+-> bit-blasting (bitblast.py) -> CDCL SAT (native C++ or pure Python).
+Optimize adds lexicographic objective optimization via incremental solving
+under activation-literal-gated bound circuits (replacing z3.Optimize).
+"""
+
+import logging
+import time
+from typing import List, Optional, Union
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.bitvec import BitVec
+from mythril_tpu.smt.bool_ import Bool
+from mythril_tpu.smt.model import Model
+from mythril_tpu.smt.solver import pysat
+from mythril_tpu.smt.solver.bitblast import Blaster, BlastError
+from mythril_tpu.smt.solver.native import make_sat
+from mythril_tpu.smt.solver.preprocess import eliminate_theories
+from mythril_tpu.smt.solver.solver_statistics import stat_smt_query
+from mythril_tpu.smt.terms import EvalEnv
+
+log = logging.getLogger(__name__)
+
+
+class CheckResult:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+sat = CheckResult("sat")
+unsat = CheckResult("unsat")
+unknown = CheckResult("unknown")
+
+_RESULT_BY_CODE = {pysat.SAT: sat, pysat.UNSAT: unsat, pysat.UNKNOWN: unknown}
+
+
+class BaseSolver:
+    def __init__(self) -> None:
+        self.constraints: List[Bool] = []
+        self.timeout: Optional[int] = None  # milliseconds
+        self.conflict_budget: Optional[int] = None
+        self._model_env: Optional[EvalEnv] = None
+        self._sat = None
+        self._blaster: Optional[Blaster] = None
+        self._ack_info = None
+
+    def set_timeout(self, timeout: int) -> None:
+        """Set the timeout for the solver, in milliseconds."""
+        self.timeout = timeout
+
+    def add(self, *constraints) -> None:
+        """Assert constraints (Bool wrappers, possibly nested in lists)."""
+        for c in constraints:
+            if isinstance(c, (list, tuple)):
+                self.add(*c)
+            elif isinstance(c, Bool):
+                self.constraints.append(c)
+            elif isinstance(c, bool):
+                self.constraints.append(Bool(terms.bool_const(c)))
+            else:
+                raise TypeError("cannot assert %r" % (c,))
+
+    def append(self, *constraints) -> None:
+        self.add(*constraints)
+
+    def reset(self) -> None:
+        self.constraints = []
+        self._model_env = None
+        self._sat = None
+        self._blaster = None
+        self._ack_info = None
+
+    # -- pipeline ------------------------------------------------------------
+
+    def _prepare(self, extra_terms: List[terms.Term]):
+        """Eliminate theories and blast; returns (blaster, sat, rewritten_extras)."""
+        assertion_terms = [c.raw for c in self.constraints]
+        rewritten, info = eliminate_theories(assertion_terms + list(extra_terms))
+        n = len(assertion_terms)
+        self._ack_info = info
+        self._sat = make_sat()
+        self._blaster = Blaster(self._sat)
+        # layout of `rewritten`: [assertions | extras | ackermann side conditions]
+        for t in rewritten[:n]:
+            self._blaster.assert_formula(t)
+        for t in rewritten[n + len(extra_terms):]:
+            self._blaster.assert_formula(t)
+        return rewritten[n : n + len(extra_terms)]
+
+    @stat_smt_query
+    def check(self, *extra_constraints) -> CheckResult:
+        """Returns sat/unsat/unknown for the asserted constraint set."""
+        extras: List[Bool] = []
+        for c in extra_constraints:
+            if isinstance(c, (list, tuple)):
+                extras.extend(c)
+            else:
+                extras.append(c)
+        self._model_env = None
+        # fast path: constant conflicts never reach the SAT solver
+        all_terms = [c.raw for c in self.constraints] + [c.raw for c in extras]
+        if any(t is terms.FALSE for t in all_terms):
+            return unsat
+        if all(t is terms.TRUE for t in all_terms):
+            self._model_env = EvalEnv()
+            return sat
+        try:
+            rewritten_extras = self._prepare([c.raw for c in extras])
+            for t in rewritten_extras:
+                self._blaster.assert_formula(t)
+        except BlastError as e:
+            log.warning("bit-blasting failed: %s", e)
+            return unknown
+        code = self._sat.solve(
+            timeout_ms=self.timeout, conflict_budget=self.conflict_budget
+        )
+        if code == pysat.SAT:
+            self._model_env = self._extract_env()
+        return _RESULT_BY_CODE[code]
+
+    def _extract_env(self) -> EvalEnv:
+        blaster, info = self._blaster, self._ack_info
+        bv_values = {
+            name: blaster.read_var(name, len(bits))
+            for name, bits in blaster.var_bits.items()
+        }
+        bool_values = {name: blaster.read_bool(name) for name in blaster.bool_vars}
+        env0 = EvalEnv(bv_values, bool_values, {}, {}, completion=True)
+        arrays = {}
+        for arr_name, entries in info.arrays.items():
+            store = {}
+            for idx_term, var_term in entries:
+                idx_val = terms.evaluate(idx_term, env0)
+                store[idx_val] = bv_values.get(var_term.params[0], 0)
+            arrays[arr_name] = (store, 0)
+        funcs = {}
+        for fname, entries in info.funcs.items():
+            table = {}
+            for arg_terms, var_term in entries:
+                key = tuple(terms.evaluate(a, env0) for a in arg_terms)
+                table[key] = bv_values.get(var_term.params[0], 0)
+            funcs[fname] = table
+        return EvalEnv(bv_values, bool_values, arrays, funcs, completion=True)
+
+    def model(self) -> Model:
+        """The model for the last sat check()."""
+        if self._model_env is None:
+            return Model()
+        return Model([self._model_env])
+
+
+class Solver(BaseSolver):
+    """Plain solver."""
+
+
+class Optimize(BaseSolver):
+    """Solver with lexicographic minimize/maximize objectives."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._objectives: List[tuple] = []  # (term, is_minimize)
+
+    def minimize(self, element: BitVec) -> None:
+        self._objectives.append((element.raw, True))
+
+    def maximize(self, element: BitVec) -> None:
+        self._objectives.append((element.raw, False))
+
+    @stat_smt_query
+    def check(self, *extra_constraints) -> CheckResult:
+        extras: List[Bool] = []
+        for c in extra_constraints:
+            if isinstance(c, (list, tuple)):
+                extras.extend(c)
+            else:
+                extras.append(c)
+        self._model_env = None
+        all_terms = [c.raw for c in self.constraints] + [c.raw for c in extras]
+        if any(t is terms.FALSE for t in all_terms):
+            return unsat
+        deadline = time.monotonic() + self.timeout / 1000.0 if self.timeout else None
+
+        def remaining_ms() -> Optional[int]:
+            if deadline is None:
+                return None
+            return max(1, int((deadline - time.monotonic()) * 1000))
+
+        try:
+            obj_terms = [t for t, _ in self._objectives]
+            rewritten = self._prepare([c.raw for c in extras] + obj_terms)
+            rewritten_extras = rewritten[: len(extras)]
+            rewritten_objs = rewritten[len(extras):]
+            for t in rewritten_extras:
+                self._blaster.assert_formula(t)
+        except BlastError as e:
+            log.warning("bit-blasting failed: %s", e)
+            return unknown
+        code = self._sat.solve(
+            timeout_ms=remaining_ms(), conflict_budget=self.conflict_budget
+        )
+        if code != pysat.SAT:
+            return _RESULT_BY_CODE[code]
+        self._model_env = self._extract_env()
+
+        # lexicographic objective optimization by binary search on bounds
+        for (obj_term, is_min), obj_rewritten in zip(self._objectives, rewritten_objs):
+            try:
+                obj_bits = self._blaster.word(obj_rewritten)
+            except BlastError:
+                break
+            current = terms.evaluate(obj_rewritten, self._model_env)
+            lo, hi = (0, current) if is_min else (current, terms.mask(obj_rewritten.size))
+            while lo < hi:
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                mid = (lo + hi) // 2 if is_min else (lo + hi + 1) // 2
+                bound = self._blaster.const_word(mid, len(obj_bits))
+                if is_min:
+                    cond = -self._blaster.w_ult(bound, obj_bits)  # obj <= mid
+                else:
+                    cond = -self._blaster.w_ult(obj_bits, bound)  # obj >= mid
+                act = self._sat.new_var()
+                self._sat.add_clause([-act, cond])
+                code = self._sat.solve(
+                    assumptions=[act],
+                    timeout_ms=remaining_ms(),
+                    conflict_budget=self.conflict_budget,
+                )
+                if code == pysat.SAT:
+                    self._model_env = self._extract_env()
+                    val = terms.evaluate(obj_rewritten, self._model_env)
+                    if is_min:
+                        hi = min(val, mid)
+                    else:
+                        lo = max(val, mid)
+                else:
+                    self._sat.add_clause([-act])
+                    if code == pysat.UNSAT:
+                        if is_min:
+                            lo = mid + 1
+                        else:
+                            hi = mid - 1
+                    else:
+                        break
+            # pin the achieved optimum before the next objective
+            best = terms.evaluate(obj_rewritten, self._model_env)
+            pin = self._blaster.w_eq(
+                obj_bits, self._blaster.const_word(best, len(obj_bits))
+            )
+            self._sat.add_clause([pin])
+        return sat

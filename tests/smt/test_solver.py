@@ -1,0 +1,228 @@
+import random
+
+import pytest
+
+from mythril_tpu.smt import (
+    And,
+    Array,
+    BitVec,
+    Bool,
+    Concat,
+    Extract,
+    Function,
+    If,
+    K,
+    Not,
+    Optimize,
+    Or,
+    Solver,
+    UGT,
+    ULT,
+    symbol_factory,
+    sat,
+    unsat,
+)
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.solver.independence_solver import IndependenceSolver
+
+
+def BV(name, size=256):
+    return symbol_factory.BitVecSym(name, size)
+
+
+def val(v, size=256):
+    return symbol_factory.BitVecVal(v, size)
+
+
+def test_trivial_sat_unsat():
+    s = Solver()
+    x = BV("x")
+    s.add(x == 3)
+    assert s.check() is sat
+    m = s.model()
+    assert m.eval(x.raw).value == 3
+
+    s = Solver()
+    s.add(x == 3, x == 4)
+    assert s.check() is unsat
+
+
+def test_add_overflow_model():
+    s = Solver()
+    x, y = BV("x", 8), BV("y", 8)
+    s.add((x + y) == 5)
+    s.add(UGT(x, val(250, 8)))
+    assert s.check() is sat
+    m = s.model()
+    xv = m.eval(x.raw).value
+    yv = m.eval(y.raw).value
+    assert (xv + yv) % 256 == 5 and xv > 250
+
+
+def test_unsat_range():
+    s = Solver()
+    x = BV("x", 16)
+    s.add(ULT(x, val(10, 16)))
+    s.add(UGT(x, val(20, 16)))
+    assert s.check() is unsat
+
+
+def test_mul_sat_small():
+    s = Solver()
+    x = BV("x", 12)
+    s.add((x * val(3, 12)) == val(123, 12))
+    assert s.check() is sat
+    xv = s.model().eval(x.raw).value
+    assert (xv * 3) % 4096 == 123
+
+
+def test_udiv_semantics_solver():
+    s = Solver()
+    x, y = BV("x", 8), BV("y", 8)
+    from mythril_tpu.smt import UDiv
+
+    s.add(y == 0)
+    s.add(UDiv(x, y) != val(255, 8))
+    assert s.check() is unsat
+
+
+def test_signed_compare():
+    s = Solver()
+    x = BV("x", 8)
+    s.add(x < val(0, 8))  # signed
+    s.add(ULT(val(0x7F, 8), x))  # unsigned: x > 127
+    assert s.check() is sat
+    xv = s.model().eval(x.raw).value
+    assert xv >= 0x80
+
+
+def test_array_theory():
+    s = Solver()
+    arr = Array("storage", 256, 256)
+    i, j = BV("i"), BV("j")
+    s.add(arr[i] == 10)
+    s.add(arr[j] == 20)
+    s.add(i == j)
+    assert s.check() is unsat
+
+    s = Solver()
+    s.add(arr[i] == 10, arr[j] == 20)
+    assert s.check() is sat
+    m = s.model()
+    iv, jv = m.eval(i.raw).value, m.eval(j.raw).value
+    assert iv != jv
+    assert m.eval(arr[i].raw, model_completion=True).value == 10
+
+
+def test_array_store_select():
+    s = Solver()
+    arr = K(256, 256, 0)
+    idx = BV("idx")
+    arr[idx] = val(42)
+    j = BV("j")
+    s.add(arr[j] == 42)
+    assert s.check() is sat  # j == idx works
+    s2 = Solver()
+    s2.add(arr[j] == 41, j == idx)
+    assert s2.check() is unsat
+
+
+def test_uninterpreted_function_congruence():
+    f = Function("keccak", 256, 256)
+    x, y = BV("x"), BV("y")
+    s = Solver()
+    s.add(x == y)
+    s.add(f(x) != f(y))
+    assert s.check() is unsat
+    s = Solver()
+    s.add(f(x) != f(y))
+    assert s.check() is sat
+
+
+def test_ite():
+    s = Solver()
+    x = BV("x")
+    cond = x == 5
+    r = If(cond, val(100), val(200))
+    s.add(r == 100)
+    assert s.check() is sat
+    assert s.model().eval(x.raw).value == 5
+
+
+def test_optimize_minimize():
+    s = Optimize()
+    x = BV("x", 16)
+    s.add(UGT(x, val(100, 16)))
+    s.minimize(x)
+    assert s.check() is sat
+    assert s.model().eval(x.raw).value == 101
+
+
+def test_optimize_maximize():
+    s = Optimize()
+    x = BV("x", 8)
+    s.add(ULT(x, val(100, 8)))
+    s.maximize(x)
+    assert s.check() is sat
+    assert s.model().eval(x.raw).value == 99
+
+
+def test_independence_solver():
+    s = IndependenceSolver()
+    x, y, a, b = BV("x"), BV("y"), BV("a"), BV("b")
+    s.add(x == y, a == b, x == 3, b == 7)
+    assert s.check() is sat
+    m = s.model()
+    assert m.eval(y.raw).value == 3
+    assert m.eval(a.raw).value == 7
+
+
+def test_solver_differential_random():
+    """Random small formulas vs brute force over 2^8 x 2^8 assignments."""
+    rng = random.Random(11)
+    for round_i in range(25):
+        size = 6
+        x, y = BV("x%d" % round_i, size), BV("y%d" % round_i, size)
+        c1 = rng.randrange(1 << size)
+        c2 = rng.randrange(1 << size)
+        lhs = rng.choice([x + y, x * y, x - y, x & y, x | y, x ^ y])
+        cmp1 = rng.choice([lhs == val(c1, size), ULT(lhs, val(c1, size))])
+        cmp2 = rng.choice([(x ^ y) == val(c2, size), UGT(y, val(c2, size))])
+        s = Solver()
+        s.add(cmp1, cmp2)
+        got = s.check()
+        # brute force
+        expected = unsat
+        formula = And(cmp1, cmp2).raw
+        from mythril_tpu.smt.terms import EvalEnv, evaluate
+
+        for xv in range(1 << size):
+            for yv in range(1 << size):
+                if evaluate(formula, EvalEnv(bv_values={"x%d" % round_i: xv, "y%d" % round_i: yv})):
+                    expected = sat
+                    break
+            if expected is sat:
+                break
+        assert got is expected, (round_i, got, expected)
+        if got is sat:
+            m = s.model()
+            env = EvalEnv(
+                bv_values={
+                    "x%d" % round_i: m.eval(x.raw, True).value,
+                    "y%d" % round_i: m.eval(y.raw, True).value,
+                }
+            )
+            assert evaluate(formula, env) is True
+
+
+def test_sha3_512bit_concat_pattern():
+    # the keccak-manager pattern: 512-bit concat input compared across widths
+    a, b = BV("a"), BV("b")
+    data = Concat(a, b)
+    assert data.size() == 512
+    s = Solver()
+    s.add(data == Concat(val(0), val(5)))
+    assert s.check() is sat
+    m = s.model()
+    assert m.eval(b.raw, True).value == 5
+    assert m.eval(a.raw, True).value == 0

@@ -1,0 +1,117 @@
+import random
+
+import pytest
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.terms import EvalEnv, evaluate
+
+
+def test_hash_consing():
+    a = terms.bv_var("x", 256)
+    b = terms.bv_var("x", 256)
+    assert a is b
+    c1 = terms.bv_add(a, terms.bv_const(1, 256))
+    c2 = terms.bv_add(b, terms.bv_const(1, 256))
+    assert c1 is c2
+
+
+def test_constant_folding():
+    a = terms.bv_const(3, 256)
+    b = terms.bv_const(5, 256)
+    assert terms.bv_add(a, b).value == 8
+    assert terms.bv_mul(a, b).value == 15
+    assert terms.bv_sub(a, b).value == (3 - 5) % 2**256
+    assert terms.bool_ult(a, b) is terms.TRUE
+    assert terms.bool_eq(a, a) is terms.TRUE
+    assert terms.bool_eq(a, b) is terms.FALSE
+
+
+def test_identity_simplifications():
+    x = terms.bv_var("x", 256)
+    zero = terms.bv_const(0, 256)
+    one = terms.bv_const(1, 256)
+    assert terms.bv_add(x, zero) is x
+    assert terms.bv_mul(x, one) is x
+    assert terms.bv_mul(x, zero) is zero
+    assert terms.bv_sub(x, x) is zero
+    assert terms.bv_xor(x, x) is zero
+
+
+def test_smtlib_division_semantics():
+    s = 8
+    allones = terms.mask(s)
+    x = terms.bv_const(13, s)
+    zero = terms.bv_const(0, s)
+    assert terms.bv_udiv(x, zero).value == allones
+    assert terms.bv_urem(x, zero).value == 13
+    neg = terms.bv_const(terms.from_signed(-13, s), s)
+    assert terms.bv_sdiv(neg, zero).value == 1
+    assert terms.bv_sdiv(x, zero).value == allones
+    assert terms.bv_srem(neg, zero).value == neg.value
+    # INT_MIN / -1 wraps
+    int_min = terms.bv_const(1 << (s - 1), s)
+    minus1 = terms.bv_const(allones, s)
+    assert terms.bv_sdiv(int_min, minus1).value == 1 << (s - 1)
+
+
+def test_concat_extract():
+    a = terms.bv_const(0xAB, 8)
+    b = terms.bv_const(0xCD, 8)
+    c = terms.bv_concat([a, b])
+    assert c.value == 0xABCD and c.size == 16
+    x = terms.bv_var("x", 16)
+    hi = terms.bv_extract(15, 8, x)
+    lo = terms.bv_extract(7, 0, x)
+    rejoined = terms.bv_concat([hi, lo])
+    env = EvalEnv(bv_values={"x": 0xBEEF})
+    assert evaluate(rejoined, env) == 0xBEEF
+
+
+def test_select_store_folding():
+    arr = terms.const_array(256, 8, 0)
+    arr = terms.array_store(arr, terms.bv_const(0, 256), terms.bv_const(0xAA, 8))
+    arr = terms.array_store(arr, terms.bv_const(1, 256), terms.bv_const(0xBB, 8))
+    assert terms.array_select(arr, terms.bv_const(0, 256)).value == 0xAA
+    assert terms.array_select(arr, terms.bv_const(1, 256)).value == 0xBB
+    assert terms.array_select(arr, terms.bv_const(5, 256)).value == 0
+    # symbolic index over a K array with no stores folds to the default
+    k = terms.const_array(256, 256, 7)
+    idx = terms.bv_var("i", 256)
+    assert terms.array_select(k, idx).value == 7
+
+
+def test_evaluate_random_differential():
+    """Random expressions: folding of const args == evaluate on var args."""
+    rng = random.Random(7)
+    ops = [
+        terms.bv_add, terms.bv_sub, terms.bv_mul, terms.bv_udiv, terms.bv_sdiv,
+        terms.bv_urem, terms.bv_srem, terms.bv_and, terms.bv_or, terms.bv_xor,
+        terms.bv_shl, terms.bv_lshr, terms.bv_ashr,
+    ]
+    size = 16
+    for _ in range(300):
+        va = rng.randrange(0, 1 << size)
+        vb = rng.randrange(0, 1 << size) if rng.random() < 0.8 else rng.choice([0, 1])
+        op = rng.choice(ops)
+        folded = op(terms.bv_const(va, size), terms.bv_const(vb, size))
+        x, y = terms.bv_var("a", size), terms.bv_var("b", size)
+        sym = op(x, y)
+        val = evaluate(sym, EvalEnv(bv_values={"a": va, "b": vb}))
+        assert folded.value == val, (op.__name__, va, vb)
+
+
+def test_eval_shift_and_signed():
+    x = terms.bv_var("x", 8)
+    env = EvalEnv(bv_values={"x": 0x80})
+    assert evaluate(terms.bv_ashr(x, terms.bv_const(1, 8)), env) == 0xC0
+    assert evaluate(terms.bv_lshr(x, terms.bv_const(1, 8)), env) == 0x40
+    assert evaluate(terms.bool_slt(x, terms.bv_const(0, 8)), env) is True
+    assert evaluate(terms.bool_ult(x, terms.bv_const(0, 8)), env) is False
+
+
+def test_mixed_width_eq_pads():
+    a = terms.bv_var("a", 256)
+    b = terms.bv_var("b", 512)
+    eq = terms.bool_eq(a, b)  # no exception; zero-pads a
+    env = EvalEnv(bv_values={"a": 5, "b": 5})
+    assert evaluate(eq, env) is True

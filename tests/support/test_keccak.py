@@ -1,0 +1,25 @@
+from mythril_tpu.support.keccak import _keccak256_py, keccak256
+from mythril_tpu.support.support_utils import get_code_hash
+
+
+def test_known_vectors():
+    assert (
+        keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # selector of transfer(address,uint256)
+    assert keccak256(b"transfer(address,uint256)")[:4].hex() == "a9059cbb"
+
+
+def test_python_fallback_matches_native():
+    for data in [b"", b"x", b"hello world", b"\x00" * 136, b"\xff" * 137, b"a" * 1000]:
+        assert keccak256(data) == _keccak256_py(data)
+
+
+def test_get_code_hash():
+    assert get_code_hash("0x") == "0x" + keccak256(b"").hex()
+    assert get_code_hash("6001") == "0x" + keccak256(bytes.fromhex("6001")).hex()
