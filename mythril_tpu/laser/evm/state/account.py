@@ -1,0 +1,147 @@
+"""Accounts and their storage (reference surface:
+mythril/laser/ethereum/state/account.py). Storage is an Array (symbolic
+default) or K (concrete-zero default) with on-chain lazy loading through a
+DynLoader; Account balance closes over the world state's shared balances
+array."""
+
+import logging
+from copy import copy, deepcopy
+from typing import Any, Dict, Set, Union
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.smt import Array, BaseArray, BitVec, K, simplify, symbol_factory
+
+log = logging.getLogger(__name__)
+
+
+class Storage:
+    """The storage of an account."""
+
+    def __init__(self, concrete: bool = False, address: BitVec = None, dynamic_loader=None) -> None:
+        """:param concrete: interpret uninitialized storage as concrete zero
+        (K array) versus unconstrained symbolic (Array)."""
+        if concrete:
+            self._standard_storage: BaseArray = K(256, 256, 0)
+        else:
+            self._standard_storage = Array("Storage", 256, 256)
+        self.printable_storage: Dict[BitVec, BitVec] = {}
+        self.dynld = dynamic_loader
+        self.storage_keys_loaded: Set[int] = set()
+        self.address = address
+
+    def __getitem__(self, item: BitVec) -> BitVec:
+        storage = self._standard_storage
+        if (
+            self.address
+            and self.address.value not in (None, 0)
+            and item.symbolic is False
+            and int(item.value) not in self.storage_keys_loaded
+            and (self.dynld and self.dynld.active)
+        ):
+            try:
+                storage[item] = symbol_factory.BitVecVal(
+                    int(
+                        self.dynld.read_storage(
+                            contract_address="0x{:040X}".format(self.address.value),
+                            index=int(item.value),
+                        ),
+                        16,
+                    ),
+                    256,
+                )
+                self.storage_keys_loaded.add(int(item.value))
+                self.printable_storage[item] = storage[item]
+            except ValueError as e:
+                log.debug("Couldn't read storage at %s: %s", item, e)
+        return simplify(storage[item])
+
+    def __setitem__(self, key: BitVec, value: Any) -> None:
+        self.printable_storage[key] = value
+        self._standard_storage[key] = value
+        if key.symbolic is False:
+            self.storage_keys_loaded.add(int(key.value))
+
+    def __deepcopy__(self, memodict=None):
+        concrete = isinstance(self._standard_storage, K)
+        storage = Storage(concrete=concrete, address=self.address, dynamic_loader=self.dynld)
+        # terms are immutable; sharing the raw store-chain is a correct copy
+        storage._standard_storage = copy(self._standard_storage)
+        storage.printable_storage = copy(self.printable_storage)
+        storage.storage_keys_loaded = copy(self.storage_keys_loaded)
+        return storage
+
+    def __str__(self) -> str:
+        return str(self.printable_storage)
+
+
+class Account:
+    """An ethereum account."""
+
+    def __init__(
+        self,
+        address: Union[BitVec, str],
+        code: Disassembly = None,
+        contract_name: str = None,
+        balances: Array = None,
+        concrete_storage: bool = False,
+        dynamic_loader=None,
+    ) -> None:
+        self.nonce = 0
+        self.code = code or Disassembly("")
+        self.address = (
+            address
+            if isinstance(address, BitVec)
+            else symbol_factory.BitVecVal(int(address, 16), 256)
+        )
+        self.storage = Storage(
+            concrete_storage, address=self.address, dynamic_loader=dynamic_loader
+        )
+        if contract_name is None:
+            self.contract_name = (
+                "{0:#0{1}x}".format(self.address.value, 42)
+                if not self.address.symbolic
+                else "unknown"
+            )
+        else:
+            self.contract_name = contract_name
+        self.deleted = False
+        self._balances = balances
+        self.balance = lambda: self._balances[self.address]
+
+    def __str__(self) -> str:
+        return str(self.as_dict)
+
+    def set_balance(self, balance: Union[int, BitVec]) -> None:
+        balance = (
+            symbol_factory.BitVecVal(balance, 256) if isinstance(balance, int) else balance
+        )
+        assert self._balances is not None
+        self._balances[self.address] = balance
+
+    def add_balance(self, balance: Union[int, BitVec]) -> None:
+        balance = (
+            symbol_factory.BitVecVal(balance, 256) if isinstance(balance, int) else balance
+        )
+        self._balances[self.address] = self._balances[self.address] + balance
+
+    @property
+    def as_dict(self) -> Dict:
+        return {
+            "nonce": self.nonce,
+            "code": self.code,
+            "balance": self.balance(),
+            "storage": self.storage,
+        }
+
+    def __copy__(self, memodict=None):
+        new_account = Account(
+            address=self.address,
+            code=self.code,
+            contract_name=self.contract_name,
+            balances=self._balances,
+        )
+        new_account.storage = deepcopy(self.storage)
+        new_account.code = self.code
+        new_account.nonce = self.nonce
+        new_account.deleted = self.deleted
+        return new_account

@@ -1,0 +1,31 @@
+"""State annotations (reference surface:
+mythril/laser/ethereum/state/annotation.py). Annotations ride along with
+states/expressions; plugins and detection modules use them as taint tags and
+scratch storage."""
+
+
+class StateAnnotation:
+    """Base class for annotations that can be attached to a GlobalState."""
+
+    @property
+    def persist_to_world_state(self) -> bool:
+        """If true, the annotation is propagated to the world state and
+        therefore to all following transactions."""
+        return False
+
+    @property
+    def persist_over_calls(self) -> bool:
+        """If true, the annotation is propagated into the global states of
+        inter-contract calls."""
+        return False
+
+
+class NoCopyAnnotation(StateAnnotation):
+    """Annotation that is shared (not copied) when states fork; use for
+    expensive immutable payloads."""
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, _):
+        return self

@@ -1,0 +1,51 @@
+"""Execution environment (reference surface:
+mythril/laser/ethereum/state/environment.py): active account, call context
+(sender/origin/value/calldata), code, and the static flag."""
+
+from typing import Dict
+
+from mythril_tpu.laser.evm.state.account import Account
+from mythril_tpu.laser.evm.state.calldata import BaseCalldata
+from mythril_tpu.smt import symbol_factory
+
+
+class Environment:
+    """The current execution environment for the symbolic executor."""
+
+    def __init__(
+        self,
+        active_account: Account,
+        sender,
+        calldata: BaseCalldata,
+        gasprice,
+        callvalue,
+        origin,
+        code=None,
+        static=False,
+    ) -> None:
+        self.active_account = active_account
+        self.active_function_name = ""
+        self.address = active_account.address
+        self.block_number = symbol_factory.BitVecSym("block_number", 256)
+        self.chainid = symbol_factory.BitVecSym("chain_id", 256)
+        self.code = active_account.code if code is None else code
+        self.sender = sender
+        self.calldata = calldata
+        self.gasprice = gasprice
+        self.origin = origin
+        self.callvalue = callvalue
+        self.static = static
+
+    def __str__(self) -> str:
+        return str(self.as_dict)
+
+    @property
+    def as_dict(self) -> Dict:
+        return dict(
+            active_account=self.active_account,
+            sender=self.sender,
+            calldata=self.calldata,
+            gasprice=self.gasprice,
+            callvalue=self.callvalue,
+            origin=self.origin,
+        )

@@ -1,0 +1,185 @@
+"""The EVM world state (reference surface:
+mythril/laser/ethereum/state/world_state.py): accounts, the shared balances
+array, the path condition, and the recorded transaction sequence."""
+
+from copy import copy
+from random import randint
+from typing import Dict, Iterator, List, Optional
+
+from mythril_tpu.laser.evm.state.account import Account
+from mythril_tpu.laser.evm.state.annotation import StateAnnotation
+from mythril_tpu.laser.evm.state.constraints import Constraints
+from mythril_tpu.support.keccak import keccak256
+from mythril_tpu.smt import Array, BitVec, symbol_factory
+
+
+def _rlp_encode(item) -> bytes:
+    """Minimal RLP encoder (bytes / int / list) for contract-address
+    derivation: address = keccak(rlp([sender, nonce]))[12:]."""
+    if isinstance(item, int):
+        if item == 0:
+            payload = b""
+        else:
+            payload = item.to_bytes((item.bit_length() + 7) // 8, "big")
+        return _rlp_encode(payload)
+    if isinstance(item, (bytes, bytearray)):
+        if len(item) == 1 and item[0] < 0x80:
+            return bytes(item)
+        return _rlp_length_prefix(len(item), 0x80) + bytes(item)
+    if isinstance(item, list):
+        payload = b"".join(_rlp_encode(x) for x in item)
+        return _rlp_length_prefix(len(payload), 0xC0) + payload
+    raise TypeError("cannot rlp-encode %r" % type(item))
+
+
+def _rlp_length_prefix(length: int, offset: int) -> bytes:
+    if length < 56:
+        return bytes([offset + length])
+    length_bytes = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(length_bytes)]) + length_bytes
+
+
+def mk_contract_address(sender: bytes, nonce: int) -> bytes:
+    """CREATE address derivation (replaces ethereum.utils.mk_contract_address)."""
+    return keccak256(_rlp_encode([sender, nonce]))[12:]
+
+
+class WorldState:
+    """The world state as described in the yellow paper."""
+
+    def __init__(
+        self,
+        transaction_sequence=None,
+        annotations: List[StateAnnotation] = None,
+        constraints: Constraints = None,
+    ) -> None:
+        self._accounts: Dict[int, Account] = {}
+        self.balances = Array("balance", 256, 256)
+        self.starting_balances = copy(self.balances)
+        self.constraints = constraints or Constraints()
+        self.node = None
+        self.transaction_sequence = transaction_sequence or []
+        self._annotations = annotations or []
+
+    @property
+    def accounts(self):
+        return self._accounts
+
+    def __getitem__(self, item: BitVec) -> Account:
+        """Accounts are auto-created on first access."""
+        try:
+            return self._accounts[item.value]
+        except KeyError:
+            new_account = Account(address=item, code=None, balances=self.balances)
+            self._accounts[item.value] = new_account
+            return new_account
+
+    def __copy__(self) -> "WorldState":
+        new_annotations = [copy(a) for a in self._annotations]
+        new_world_state = WorldState(
+            transaction_sequence=self.transaction_sequence[:],
+            annotations=new_annotations,
+        )
+        new_world_state.balances = copy(self.balances)
+        new_world_state.starting_balances = copy(self.starting_balances)
+        for account in self._accounts.values():
+            new_world_state.put_account(copy(account))
+        new_world_state.node = self.node
+        new_world_state.constraints = copy(self.constraints)
+        return new_world_state
+
+    def accounts_exist_or_load(self, addr, dynamic_loader) -> Account:
+        """Existing account, or one loaded through the dynamic loader."""
+        if isinstance(addr, int):
+            addr_bitvec = symbol_factory.BitVecVal(addr, 256)
+        elif isinstance(addr, BitVec):
+            addr_bitvec = addr
+        else:
+            addr_bitvec = symbol_factory.BitVecVal(int(addr, 16), 256)
+
+        if addr_bitvec.value in self.accounts:
+            return self.accounts[addr_bitvec.value]
+        if dynamic_loader is None:
+            raise ValueError("dynamic_loader is None")
+        addr_hex = (
+            addr if isinstance(addr, str) else "{0:#0{1}x}".format(addr_bitvec.value, 42)
+        )
+        try:
+            balance = dynamic_loader.read_balance(addr_hex)
+            return self.create_account(
+                balance=balance,
+                address=addr_bitvec.value,
+                dynamic_loader=dynamic_loader,
+                code=dynamic_loader.dynld(addr_hex),
+            )
+        except Exception:
+            pass
+        return self.create_account(
+            address=addr_bitvec.value,
+            dynamic_loader=dynamic_loader,
+            code=dynamic_loader.dynld(addr_hex),
+        )
+
+    def create_account(
+        self,
+        balance=0,
+        address=None,
+        concrete_storage=False,
+        dynamic_loader=None,
+        creator=None,
+        code=None,
+        nonce=0,
+    ) -> Account:
+        address = (
+            symbol_factory.BitVecVal(address, 256)
+            if address is not None
+            else self._generate_new_address(creator)
+        )
+        new_account = Account(
+            address=address,
+            balances=self.balances,
+            dynamic_loader=dynamic_loader,
+            concrete_storage=concrete_storage,
+        )
+        if code:
+            new_account.code = code
+        new_account.nonce = nonce
+        new_account.set_balance(
+            balance
+            if isinstance(balance, BitVec)
+            else symbol_factory.BitVecVal(balance, 256)
+        )
+        self.put_account(new_account)
+        return new_account
+
+    def create_initialized_contract_account(self, contract_code, storage) -> None:
+        """New contract account from runtime bytecode + initial storage."""
+        new_account = Account(
+            self._generate_new_address(), code=contract_code, balances=self.balances
+        )
+        new_account.storage = storage
+        self.put_account(new_account)
+
+    def annotate(self, annotation: StateAnnotation) -> None:
+        self._annotations.append(annotation)
+
+    @property
+    def annotations(self) -> List[StateAnnotation]:
+        return self._annotations
+
+    def get_annotations(self, annotation_type: type) -> Iterator[StateAnnotation]:
+        return filter(lambda x: isinstance(x, annotation_type), self.annotations)
+
+    def _generate_new_address(self, creator=None) -> BitVec:
+        if creator:
+            address = "0x" + mk_contract_address(bytes.fromhex(creator[-40:]), 0).hex()
+            return symbol_factory.BitVecVal(int(address, 16), 256)
+        while True:
+            address = "0x" + "".join([str(hex(randint(0, 16)))[-1] for _ in range(40)])
+            if address not in self._accounts.keys():
+                return symbol_factory.BitVecVal(int(address, 16), 256)
+
+    def put_account(self, account: Account) -> None:
+        self._accounts[account.address.value] = account
+        account._balances = self.balances
+        account.balance = lambda: account._balances[account.address]

@@ -1,0 +1,26 @@
+"""Global execution-time budget (reference surface:
+mythril/laser/ethereum/time_handler.py). The solver couples its per-query
+timeout to the remaining execution time via time_remaining()."""
+
+import time
+
+from mythril_tpu.support.support_utils import Singleton
+
+
+class TimeHandler(object, metaclass=Singleton):
+    def __init__(self):
+        self._start_time = None
+        self._execution_time = None
+
+    def start_execution(self, execution_time: int):
+        self._start_time = int(time.time() * 1000)
+        self._execution_time = execution_time * 1000
+
+    def time_remaining(self) -> int:
+        """Milliseconds left in the execution budget."""
+        if self._start_time is None:
+            return 100000000
+        return self._execution_time - (int(time.time() * 1000) - self._start_time)
+
+
+time_handler = TimeHandler()

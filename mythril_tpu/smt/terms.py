@@ -328,12 +328,19 @@ def bv_concat(args: Iterable[Term]) -> Term:
             arglist.append(a)
     if not arglist:
         raise ValueError("concat of zero terms")
-    # merge adjacent constants
+    # merge adjacent constants and adjacent extracts of the same base term
     merged = [arglist[0]]
     for a in arglist[1:]:
         prev = merged[-1]
         if a.is_const and prev.is_const:
             merged[-1] = bv_const((prev.value << a.size) | a.value, prev.size + a.size)
+        elif (
+            a.op == "extract"
+            and prev.op == "extract"
+            and a.args[0] is prev.args[0]
+            and prev.params[1] == a.params[0] + 1
+        ):
+            merged[-1] = bv_extract(prev.params[0], a.params[1], a.args[0])
         else:
             merged.append(a)
     if len(merged) == 1:

@@ -1,0 +1,98 @@
+import pytest
+
+from mythril_tpu.laser.evm.evm_exceptions import (
+    StackOverflowException,
+    StackUnderflowException,
+)
+from mythril_tpu.laser.evm.state.calldata import ConcreteCalldata, SymbolicCalldata
+from mythril_tpu.laser.evm.state.machine_state import MachineStack, MachineState
+from mythril_tpu.laser.evm.state.memory import Memory
+from mythril_tpu.laser.evm.state.world_state import WorldState
+from mythril_tpu.smt import Solver, sat, symbol_factory
+
+
+def test_stack_overflow_underflow():
+    stack = MachineStack()
+    with pytest.raises(StackUnderflowException):
+        stack.pop()
+    for i in range(MachineStack.STACK_LIMIT):
+        stack.append(i)
+    with pytest.raises(StackOverflowException):
+        stack.append(1)
+
+
+def test_stack_int_coercion():
+    stack = MachineStack()
+    stack.append(7)
+    assert stack[0].value == 7
+    assert stack[0].size() == 256
+
+
+def test_machine_state_pop_order():
+    mstate = MachineState(gas_limit=8000000)
+    mstate.stack.append(1)
+    mstate.stack.append(2)
+    mstate.stack.append(3)
+    a, b = mstate.pop(2)
+    assert a.value == 3 and b.value == 2  # top first
+
+
+def test_memory_gas_quadratic():
+    mstate = MachineState(gas_limit=8000000)
+    mstate.mem_extend(0, 32)
+    assert mstate.memory_size == 32
+    assert mstate.min_gas_used == 3
+    mstate.mem_extend(0, 32)  # no growth, no charge
+    assert mstate.min_gas_used == 3
+    big = MachineState(gas_limit=8000000)
+    big.mem_extend(0, 32 * 512)
+    assert big.min_gas_used == 512 * 3 + 512**2 // 512
+
+
+def test_memory_word_roundtrip():
+    mem = Memory()
+    mem.extend(64)
+    mem.write_word_at(0, symbol_factory.BitVecVal(0xDEADBEEF, 256))
+    assert mem.get_word_at(0).value == 0xDEADBEEF
+    sym = symbol_factory.BitVecSym("w", 256)
+    mem.write_word_at(32, sym)
+    back = mem.get_word_at(32)
+    assert back.raw is sym.raw
+
+
+def test_concrete_calldata():
+    cd = ConcreteCalldata("1", [1, 2, 3, 4])
+    assert cd.size == 4
+    assert cd[0].value == 1
+    assert cd[3].value == 4
+    assert cd[10].value == 0  # out of bounds -> 0 default
+    word = cd.get_word_at(0)
+    assert word.value == int.from_bytes(bytes([1, 2, 3, 4] + [0] * 28), "big")
+
+
+def test_symbolic_calldata_oob_zero():
+    cd = SymbolicCalldata("2")
+    s = Solver()
+    size_is_two = cd.calldatasize == 2
+    third = cd[2]  # index 2 >= size 2 -> must be 0
+    s.add(size_is_two, third != 0)
+    assert s.check() is not sat
+
+
+def test_world_state_autocreate_account():
+    ws = WorldState()
+    addr = symbol_factory.BitVecVal(0xAFFE, 256)
+    acc = ws[addr]
+    assert acc.address.value == 0xAFFE
+    assert ws[addr] is acc
+    acc.set_balance(100)
+    assert ws.balances[addr].value == 100
+
+
+def test_world_state_copy_isolation():
+    ws = WorldState()
+    acc = ws.create_account(balance=10, address=1)
+    ws2 = ws.__copy__()
+    ws2.accounts[1].set_balance(999)
+    assert ws.balances[symbol_factory.BitVecVal(1, 256)].value == 10
+    assert ws2.balances[symbol_factory.BitVecVal(1, 256)].value == 999
