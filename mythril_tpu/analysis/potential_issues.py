@@ -1,0 +1,91 @@
+"""Deferred-solve issue pattern (reference surface:
+mythril/analysis/potential_issues.py): detection modules record
+PotentialIssues with extra constraints; at transaction end the engine tries
+to concretize a witnessing transaction sequence and promotes survivors to
+real Issues."""
+
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.state.annotation import StateAnnotation
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+
+
+class PotentialIssue:
+    """An issue missing only its transaction sequence."""
+
+    def __init__(
+        self,
+        contract,
+        function_name,
+        address,
+        swc_id,
+        title,
+        bytecode,
+        detector,
+        severity=None,
+        description_head="",
+        description_tail="",
+        constraints=None,
+    ):
+        self.title = title
+        self.contract = contract
+        self.function_name = function_name
+        self.address = address
+        self.description_head = description_head
+        self.description_tail = description_tail
+        self.severity = severity
+        self.swc_id = swc_id
+        self.bytecode = bytecode
+        self.constraints = constraints or []
+        self.detector = detector
+
+
+class PotentialIssuesAnnotation(StateAnnotation):
+    def __init__(self):
+        self.potential_issues = []
+
+    @property
+    def persist_over_calls(self) -> bool:
+        return True
+
+
+def get_potential_issues_annotation(state: GlobalState) -> PotentialIssuesAnnotation:
+    """The state's PotentialIssuesAnnotation (created on demand)."""
+    for annotation in state.annotations:
+        if isinstance(annotation, PotentialIssuesAnnotation):
+            return annotation
+    annotation = PotentialIssuesAnnotation()
+    state.annotate(annotation)
+    return annotation
+
+
+def check_potential_issues(state: GlobalState) -> None:
+    """Called at transaction end: try to concretize each potential issue's
+    constraints; on success promote it to a real Issue on its detector."""
+    annotation = get_potential_issues_annotation(state)
+    for potential_issue in annotation.potential_issues[:]:
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints + potential_issue.constraints
+            )
+        except UnsatError:
+            continue
+
+        annotation.potential_issues.remove(potential_issue)
+        potential_issue.detector.cache.add(potential_issue.address)
+        potential_issue.detector.issues.append(
+            Issue(
+                contract=potential_issue.contract,
+                function_name=potential_issue.function_name,
+                address=potential_issue.address,
+                title=potential_issue.title,
+                bytecode=potential_issue.bytecode,
+                swc_id=potential_issue.swc_id,
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                severity=potential_issue.severity,
+                description_head=potential_issue.description_head,
+                description_tail=potential_issue.description_tail,
+                transaction_sequence=transaction_sequence,
+            )
+        )

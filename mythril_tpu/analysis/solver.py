@@ -1,0 +1,205 @@
+"""Constraint solving for detection modules (reference surface:
+mythril/analysis/solver.py): model extraction with lexicographic
+minimization of calldata sizes / call values, and concretization of full
+transaction sequences (including keccak back-substitution) from a model."""
+
+import logging
+from functools import lru_cache
+from typing import Dict, List, Tuple, Union
+
+from mythril_tpu.analysis.analysis_args import analysis_args
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.keccak_function_manager import (
+    hash_matcher,
+    keccak_function_manager,
+)
+from mythril_tpu.laser.evm.state.constraints import Constraints
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.time_handler import time_handler
+from mythril_tpu.laser.evm.transaction import BaseTransaction
+from mythril_tpu.laser.evm.transaction.transaction_models import (
+    ContractCreationTransaction,
+)
+from mythril_tpu.smt import Optimize, UGE, sat, symbol_factory, unknown
+
+log = logging.getLogger(__name__)
+
+
+@lru_cache(maxsize=2**23)
+def get_model(constraints, minimize=(), maximize=(), enforce_execution_time=True):
+    """Solve the constraint set, optionally optimizing objectives.
+
+    :raises UnsatError: on unsat or timeout
+    """
+    s = Optimize()
+    timeout = analysis_args.solver_timeout
+    if enforce_execution_time:
+        timeout = min(timeout, time_handler.time_remaining() - 500)
+        if timeout <= 0:
+            raise UnsatError
+    s.set_timeout(timeout)
+
+    for constraint in constraints:
+        if type(constraint) == bool and not constraint:
+            raise UnsatError
+    constraints = [c for c in constraints if type(c) != bool]
+    for constraint in constraints:
+        s.add(constraint)
+    for e in minimize:
+        s.minimize(e)
+    for e in maximize:
+        s.maximize(e)
+    result = s.check()
+    if result is sat:
+        return s.model()
+    if result is unknown:
+        log.debug("Timeout/incomplete result while solving expression")
+    raise UnsatError
+
+
+def pretty_print_model(model):
+    """Pretty print a model."""
+    ret = ""
+    for name in model.decls():
+        ret += "%s\n" % name
+    return ret
+
+
+def get_transaction_sequence(global_state: GlobalState, constraints: Constraints) -> Dict:
+    """Generate a concrete transaction sequence witnessing the constraints."""
+    transaction_sequence = global_state.world_state.transaction_sequence
+    concrete_transactions = []
+
+    tx_constraints, minimize = _set_minimisation_constraints(
+        transaction_sequence, constraints.copy(), [], 5000, global_state.world_state
+    )
+    model = get_model(tuple(tx_constraints), minimize=tuple(minimize))
+
+    initial_world_state = transaction_sequence[0].world_state
+    initial_accounts = initial_world_state.accounts
+
+    for transaction in transaction_sequence:
+        concrete_transaction = _get_concrete_transaction(model, transaction)
+        concrete_transactions.append(concrete_transaction)
+
+    min_price_dict: Dict[str, int] = {}
+    for address in initial_accounts.keys():
+        min_price_dict[address] = model.eval(
+            initial_world_state.starting_balances[
+                symbol_factory.BitVecVal(address, 256)
+            ].raw,
+            model_completion=True,
+        ).value
+
+    concrete_initial_state = _get_concrete_state(initial_accounts, min_price_dict)
+    if isinstance(transaction_sequence[0], ContractCreationTransaction):
+        code = transaction_sequence[0].code
+        _replace_with_actual_sha(concrete_transactions, model, code)
+    else:
+        _replace_with_actual_sha(concrete_transactions, model)
+    _add_calldata_placeholder(concrete_transactions, transaction_sequence)
+    return {"initialState": concrete_initial_state, "steps": concrete_transactions}
+
+
+def _add_calldata_placeholder(concrete_transactions, transaction_sequence):
+    for tx in concrete_transactions:
+        tx["calldata"] = tx["input"]
+    if not isinstance(transaction_sequence[0], ContractCreationTransaction):
+        return
+    code_len = len(transaction_sequence[0].code.bytecode)
+    concrete_transactions[0]["calldata"] = concrete_transactions[0]["input"][code_len + 2 :]
+
+
+def _replace_with_actual_sha(concrete_transactions, model, code=None):
+    """Replace placeholder hash values in concretized calldata with real
+    keccaks of the recovered preimages."""
+    concrete_hashes = keccak_function_manager.get_concrete_hash_data(model)
+    for tx in concrete_transactions:
+        if hash_matcher not in tx["input"]:
+            continue
+        if code is not None and code.bytecode in tx["input"]:
+            s_index = len(code.bytecode) + 2
+        else:
+            s_index = 10
+        for i in range(s_index, len(tx["input"])):
+            data_slice = tx["input"][i : i + 64]
+            if hash_matcher not in data_slice or len(data_slice) != 64:
+                continue
+            find_input = symbol_factory.BitVecVal(int(data_slice, 16), 256)
+            input_ = None
+            for size in concrete_hashes:
+                if find_input.value not in concrete_hashes[size]:
+                    continue
+                _, inverse = keccak_function_manager.store_function[size]
+                eval_ = model.eval(inverse(find_input).raw, model_completion=True)
+                input_ = symbol_factory.BitVecVal(eval_.value, size)
+            if input_ is None:
+                continue
+            keccak = keccak_function_manager.find_concrete_keccak(input_)
+            hex_keccak = hex(keccak.value)[2:].zfill(64)
+            tx["input"] = tx["input"][:s_index] + tx["input"][s_index:].replace(
+                tx["input"][i : 64 + i], hex_keccak
+            )
+
+
+def _get_concrete_state(initial_accounts: Dict, min_price_dict: Dict[str, int]):
+    accounts = {}
+    for address, account in initial_accounts.items():
+        data: Dict[str, Union[int, str]] = dict()
+        data["nonce"] = account.nonce
+        data["code"] = account.code.bytecode
+        data["storage"] = str(account.storage)
+        data["balance"] = hex(min_price_dict.get(address, 0))
+        accounts[hex(address)] = data
+    return {"accounts": accounts}
+
+
+def _get_concrete_transaction(model, transaction: BaseTransaction):
+    address = hex(transaction.callee_account.address.value)
+    value = model.eval(transaction.call_value.raw, model_completion=True).value
+    caller = "0x" + (
+        "%x" % model.eval(transaction.caller.raw, model_completion=True).value
+    ).zfill(40)
+
+    input_ = ""
+    if isinstance(transaction, ContractCreationTransaction):
+        address = ""
+        input_ += transaction.code.bytecode
+
+    input_ += "".join(
+        "%02x" % b if isinstance(b, int) else "%02x" % b.value
+        for b in transaction.call_data.concrete(model)
+    )
+
+    return {
+        "input": "0x" + input_,
+        "value": "0x%x" % value,
+        "origin": caller,
+        "address": "%s" % address,
+    }
+
+
+def _set_minimisation_constraints(
+    transaction_sequence, constraints, minimize, max_size, world_state
+) -> Tuple[Constraints, tuple]:
+    """Bound calldata sizes, minimize calldata sizes and call values, and
+    bound starting balances to "reasonable" amounts."""
+    for transaction in transaction_sequence:
+        max_calldata_size = symbol_factory.BitVecVal(max_size, 256)
+        constraints.append(UGE(max_calldata_size, transaction.call_data.calldatasize))
+        minimize.append(transaction.call_data.calldatasize)
+        minimize.append(transaction.call_value)
+        constraints.append(
+            UGE(
+                symbol_factory.BitVecVal(1000000000000000000000, 256),
+                world_state.starting_balances[transaction.caller],
+            )
+        )
+    for account in world_state.accounts.values():
+        constraints.append(
+            UGE(
+                symbol_factory.BitVecVal(100000000000000000000, 256),
+                world_state.starting_balances[account.address],
+            )
+        )
+    return constraints, tuple(minimize)

@@ -141,6 +141,10 @@ def assemble(source: str) -> bytes:
             continue
         parts = line.split()
         mnemonic = parts[0].upper()
+        # accept modern aliases for the table's legacy names
+        mnemonic = {"SELFDESTRUCT": "SUICIDE", "KECCAK256": "SHA3", "INVALID": "ASSERT_FAIL"}.get(
+            mnemonic, mnemonic
+        )
         arg = parts[1] if len(parts) > 1 else None
         match_push = regex_PUSH.match(mnemonic)
         if mnemonic not in reverse_opcodes:
@@ -155,6 +159,8 @@ def assemble(source: str) -> bytes:
         match_push = regex_PUSH.match(mnemonic)
         if match_push:
             width = int(match_push.group(1))
+            if width == 0:  # PUSH0 takes no immediate
+                continue
             if arg is None:
                 raise AssembleError("%s needs an argument" % mnemonic)
             if arg.startswith(":"):

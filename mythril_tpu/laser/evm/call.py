@@ -1,0 +1,208 @@
+"""Call-parameter extraction for the CALL family (reference surface:
+mythril/laser/ethereum/call.py): pops stack arguments, resolves (possibly
+symbolic) callee addresses, builds calldata views, and dispatches
+precompiles."""
+
+import logging
+import re
+from typing import List, Optional, Union, cast
+
+from mythril_tpu.laser.evm import natives, util
+from mythril_tpu.laser.evm.state.account import Account
+from mythril_tpu.laser.evm.state.calldata import (
+    BaseCalldata,
+    ConcreteCalldata,
+    SymbolicCalldata,
+)
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.support.opcodes import GSTIPEND, calculate_native_gas
+from mythril_tpu.smt import BitVec, Expression, If, is_true, simplify, symbol_factory
+
+log = logging.getLogger(__name__)
+
+
+def get_call_parameters(global_state: GlobalState, dynamic_loader, with_value=False):
+    """Pop the call arguments and resolve the callee.
+
+    :return: (callee_address, callee_account, call_data, value, gas,
+              memory_out_offset, memory_out_size)
+    """
+    gas, to = global_state.mstate.pop(2)
+    value = global_state.mstate.pop() if with_value else 0
+    (
+        memory_input_offset,
+        memory_input_size,
+        memory_out_offset,
+        memory_out_size,
+    ) = global_state.mstate.pop(4)
+
+    callee_address = get_callee_address(global_state, dynamic_loader, to)
+
+    callee_account = None
+    call_data = get_call_data(global_state, memory_input_offset, memory_input_size)
+    if isinstance(callee_address, BitVec) or (
+        isinstance(callee_address, str)
+        and (int(callee_address, 16) > natives.PRECOMPILE_COUNT or int(callee_address, 16) == 0)
+    ):
+        callee_account = get_callee_account(global_state, callee_address, dynamic_loader)
+
+    gas = gas + If(value > 0, symbol_factory.BitVecVal(GSTIPEND, gas.size()), 0)
+    return (
+        callee_address,
+        callee_account,
+        call_data,
+        value,
+        gas,
+        memory_out_offset,
+        memory_out_size,
+    )
+
+
+def _get_padded_hex_address(address: int) -> str:
+    hex_address = hex(address)[2:]
+    return "0x{}{}".format("0" * (40 - len(hex_address)), hex_address)
+
+
+def get_callee_address(global_state: GlobalState, dynamic_loader, symbolic_to_address: Expression):
+    """Resolve the callee address; a symbolic Storage[i] address is looked up
+    on-chain through the dynamic loader when available."""
+    environment = global_state.environment
+    try:
+        return _get_padded_hex_address(util.get_concrete_int(symbolic_to_address))
+    except TypeError:
+        log.debug("Symbolic call encountered")
+
+    match = re.search(r"Storage\[(\d+)\]", str(simplify(symbolic_to_address)))
+    if match is None or dynamic_loader is None:
+        return symbolic_to_address
+
+    index = int(match.group(1))
+    log.debug("Dynamic contract address at storage index %d", index)
+    try:
+        callee_address = dynamic_loader.read_storage(
+            "0x{:040X}".format(environment.active_account.address.value), index
+        )
+    except Exception:
+        return symbolic_to_address
+    if not re.match(r"^0x[0-9a-f]{40}$", callee_address):
+        callee_address = "0x" + callee_address[26:]
+    return callee_address
+
+
+def get_callee_account(global_state: GlobalState, callee_address: Union[str, BitVec], dynamic_loader):
+    """The callee's account (auto-created / loaded as needed)."""
+    if isinstance(callee_address, BitVec):
+        if callee_address.symbolic:
+            return Account(callee_address, balances=global_state.world_state.balances)
+        callee_address = hex(callee_address.value)[2:]
+    try:
+        return global_state.world_state.accounts_exist_or_load(callee_address, dynamic_loader)
+    except ValueError:
+        # no dynamic loader: auto-create an empty account
+        return global_state.world_state[
+            symbol_factory.BitVecVal(int(callee_address, 16), 256)
+        ]
+
+
+def get_call_data(
+    global_state: GlobalState,
+    memory_start: Union[int, BitVec],
+    memory_size: Union[int, BitVec],
+):
+    """Calldata view for a nested call: reuses the caller's calldata when the
+    full window is forwarded; otherwise copies the memory slice."""
+    state = global_state.mstate
+    transaction_id = "{}_internalcall".format(global_state.current_transaction.id)
+
+    memory_start = cast(
+        BitVec,
+        (
+            symbol_factory.BitVecVal(memory_start, 256)
+            if isinstance(memory_start, int)
+            else memory_start
+        ),
+    )
+    memory_size = cast(
+        BitVec,
+        (
+            symbol_factory.BitVecVal(memory_size, 256)
+            if isinstance(memory_size, int)
+            else memory_size
+        ),
+    )
+
+    uses_entire_calldata = simplify(
+        memory_size == global_state.environment.calldata.calldatasize
+    )
+    if is_true(uses_entire_calldata):
+        return global_state.environment.calldata
+
+    try:
+        calldata_from_mem = state.memory[
+            util.get_concrete_int(memory_start) : util.get_concrete_int(
+                memory_start + memory_size
+            )
+        ]
+        return ConcreteCalldata(transaction_id, calldata_from_mem)
+    except TypeError:
+        log.debug("Unsupported symbolic memory offset %s size %s", memory_start, memory_size)
+        return SymbolicCalldata(transaction_id)
+
+
+def insert_ret_val(global_state: GlobalState):
+    retval = global_state.new_bitvec(
+        "retval_" + str(global_state.get_current_instruction()["address"]), 256
+    )
+    global_state.mstate.stack.append(retval)
+    global_state.world_state.constraints.append(retval == 1)
+
+
+def native_call(
+    global_state: GlobalState,
+    callee_address: Union[str, BitVec],
+    call_data: BaseCalldata,
+    memory_out_offset: Union[int, Expression],
+    memory_out_size: Union[int, Expression],
+) -> Optional[List[GlobalState]]:
+    """Handle a precompile call; returns None when the target is not a
+    precompile (a regular transaction should be started instead)."""
+    if (
+        isinstance(callee_address, BitVec)
+        or not 0 < int(callee_address, 16) <= natives.PRECOMPILE_COUNT
+    ):
+        return None
+
+    log.debug("Native contract called: %s", callee_address)
+    try:
+        mem_out_start = util.get_concrete_int(memory_out_offset)
+        mem_out_sz = util.get_concrete_int(memory_out_size)
+    except TypeError:
+        log.debug("CALL with symbolic start or offset not supported")
+        return [global_state]
+
+    call_address_int = int(callee_address, 16)
+    native_gas_min, native_gas_max = calculate_native_gas(
+        global_state.mstate.calculate_extension_size(mem_out_start, mem_out_sz),
+        natives.PRECOMPILE_FUNCTIONS[call_address_int - 1].__name__,
+    )
+    global_state.mstate.min_gas_used += native_gas_min
+    global_state.mstate.max_gas_used += native_gas_max
+    global_state.mstate.mem_extend(mem_out_start, mem_out_sz)
+
+    try:
+        data = natives.native_contracts(call_address_int, call_data)
+    except natives.NativeContractException:
+        for i in range(mem_out_sz):
+            global_state.mstate.memory[mem_out_start + i] = global_state.new_bitvec(
+                natives.PRECOMPILE_FUNCTIONS[call_address_int - 1].__name__
+                + "(" + str(call_data) + ")",
+                8,
+            )
+        insert_ret_val(global_state)
+        return [global_state]
+
+    for i in range(min(len(data), mem_out_sz)):  # excess data is chopped off
+        global_state.mstate.memory[mem_out_start + i] = data[i]
+
+    insert_ret_val(global_state)
+    return [global_state]

@@ -1,0 +1,28 @@
+"""Per-opcode wall-time profiler (reference surface:
+mythril/laser/ethereum/iprof.py), enabled by --enable-iprof."""
+
+from collections import defaultdict
+from typing import Dict, List
+
+
+class InstructionProfiler:
+    """Aggregates min/max/avg wall time per opcode."""
+
+    def __init__(self):
+        self.records: Dict[str, List[float]] = defaultdict(list)
+
+    def record(self, op: str, start: float, end: float) -> None:
+        self.records[op].append(end - start)
+
+    def __repr__(self) -> str:
+        total = 0.0
+        lines = []
+        for op, durations in sorted(self.records.items()):
+            s = sum(durations)
+            total += s
+            lines.append(
+                "[%-12s] %.4f %%, nr %d, total %f s, avg %f s, min %f s, max %f s"
+                % (op, 0, len(durations), s, s / len(durations), min(durations), max(durations))
+            )
+        header = "Total: %f s\n" % total
+        return header + "\n".join(lines)

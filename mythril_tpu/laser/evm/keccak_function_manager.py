@@ -1,0 +1,124 @@
+"""Symbolic keccak modeling (reference surface:
+mythril/laser/ethereum/keccak_function_manager.py).
+
+Hashes are modeled as uninterpreted-function pairs keccak256_<size> and an
+inverse, with VerX-style constraints: each input size gets a disjoint output
+interval, outputs are ≡ 0 mod 64 (so mapping/array slots spread), and the
+inverse axiom makes the functions injective per encountered input. Concrete
+inputs are hashed for real (batched on TPU by laser/tpu/keccak_jax.py when
+many lanes hash at once)."""
+
+from typing import Dict, List, Optional, Tuple
+
+from mythril_tpu.support.keccak import keccak256
+from mythril_tpu.smt import (
+    And,
+    BitVec,
+    Bool,
+    Function,
+    Or,
+    ULE,
+    ULT,
+    URem,
+    symbol_factory,
+)
+
+TOTAL_PARTS = 10**40
+PART = (2**256 - 1) // TOTAL_PARTS
+INTERVAL_DIFFERENCE = 10**30
+hash_matcher = "fffffff"  # usual prefix for hashes in concretized output
+
+
+class KeccakFunctionManager:
+    def __init__(self):
+        self.store_function: Dict[int, Tuple[Function, Function]] = {}
+        self.interval_hook_for_size: Dict[int, int] = {}
+        self._index_counter = TOTAL_PARTS - 34534
+        self.hash_result_store: Dict[int, List[BitVec]] = {}
+        self.quick_inverse: Dict[BitVec, BitVec] = {}  # for concolic runs
+        self.concrete_hashes: Dict[BitVec, BitVec] = {}
+
+    def reset(self):
+        self.__init__()
+
+    @staticmethod
+    def find_concrete_keccak(data: BitVec) -> BitVec:
+        """Actually hash a concrete input."""
+        return symbol_factory.BitVecVal(
+            int.from_bytes(
+                keccak256(data.value.to_bytes(data.size() // 8, byteorder="big")), "big"
+            ),
+            256,
+        )
+
+    def get_function(self, length: int) -> Tuple[Function, Function]:
+        """The (keccak, inverse) UF pair for a given input bit-length."""
+        try:
+            func, inverse = self.store_function[length]
+        except KeyError:
+            func = Function("keccak256_{}".format(length), length, 256)
+            inverse = Function("keccak256_{}-1".format(length), 256, length)
+            self.store_function[length] = (func, inverse)
+            self.hash_result_store[length] = []
+        return func, inverse
+
+    @staticmethod
+    def get_empty_keccak_hash() -> BitVec:
+        """keccak256("")"""
+        val = 89477152217924674838424037953991966239322087453347756267410168184682657981552
+        return symbol_factory.BitVecVal(val, 256)
+
+    def create_keccak(self, data: BitVec) -> Tuple[BitVec, Bool]:
+        """Returns (hash expression, side condition)."""
+        length = data.size()
+        func, inverse = self.get_function(length)
+
+        if data.symbolic is False:
+            concrete_hash = self.find_concrete_keccak(data)
+            self.concrete_hashes[data] = concrete_hash
+            self.quick_inverse[concrete_hash] = data
+            condition = And(func(data) == concrete_hash, inverse(func(data)) == data)
+            return concrete_hash, condition
+
+        condition = self._create_condition(func_input=data)
+        self.hash_result_store[length].append(func(data))
+        return func(data), condition
+
+    def get_concrete_hash_data(self, model) -> Dict[int, List[Optional[int]]]:
+        """Concrete values of all symbolic hashes under a model."""
+        concrete_hashes: Dict[int, List[Optional[int]]] = {}
+        for size in self.hash_result_store:
+            concrete_hashes[size] = []
+            for val in self.hash_result_store[size]:
+                eval_ = model.eval(val.raw, model_completion=False)
+                if eval_ is not None and eval_.value is not None:
+                    concrete_hashes[size].append(eval_.value)
+        return concrete_hashes
+
+    def _create_condition(self, func_input: BitVec) -> Bool:
+        length = func_input.size()
+        func, inv = self.get_function(length)
+        try:
+            index = self.interval_hook_for_size[length]
+        except KeyError:
+            self.interval_hook_for_size[length] = self._index_counter
+            index = self._index_counter
+            self._index_counter -= INTERVAL_DIFFERENCE
+
+        lower_bound = index * PART
+        upper_bound = lower_bound + PART
+
+        cond = And(
+            inv(func(func_input)) == func_input,
+            ULE(symbol_factory.BitVecVal(lower_bound, 256), func(func_input)),
+            ULT(func(func_input), symbol_factory.BitVecVal(upper_bound, 256)),
+            URem(func(func_input), symbol_factory.BitVecVal(64, 256)) == 0,
+        )
+        concrete_cond = symbol_factory.Bool(False)
+        for key, keccak in self.concrete_hashes.items():
+            hash_eq = And(func(func_input) == keccak, key == func_input)
+            concrete_cond = Or(concrete_cond, hash_eq)
+        return And(inv(func(func_input)) == func_input, Or(cond, concrete_cond))
+
+
+keccak_function_manager = KeccakFunctionManager()

@@ -1,0 +1,3 @@
+from mythril_tpu.laser.evm.plugins.implementations.coverage.coverage_plugin import (
+    InstructionCoveragePlugin,
+)

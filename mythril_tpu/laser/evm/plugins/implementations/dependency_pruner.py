@@ -1,0 +1,218 @@
+"""Dependency pruner (reference surface:
+mythril/laser/ethereum/plugins/implementations/dependency_pruner.py).
+
+Per basic block, tracks storage locations read on paths through it; from
+transaction 2 on, blocks whose reads cannot alias any storage written in the
+previous transaction are skipped."""
+
+import logging
+from typing import Dict, List, Set, cast
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.plugins.implementations.plugin_annotations import (
+    DependencyAnnotation,
+    WSDependencyAnnotation,
+)
+from mythril_tpu.laser.evm.plugins.plugin import LaserPlugin
+from mythril_tpu.laser.evm.plugins.signals import PluginSkipState
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.transaction.transaction_models import (
+    ContractCreationTransaction,
+)
+
+log = logging.getLogger(__name__)
+
+
+def get_dependency_annotation(state: GlobalState) -> DependencyAnnotation:
+    """The state's dependency annotation; on a fresh transaction the previous
+    transaction's annotation is popped from the world-state stack."""
+    annotations = cast(
+        List[DependencyAnnotation], list(state.get_annotations(DependencyAnnotation))
+    )
+    if len(annotations) == 0:
+        try:
+            world_state_annotation = get_ws_dependency_annotation(state)
+            annotation = world_state_annotation.annotations_stack.pop()
+        except IndexError:
+            annotation = DependencyAnnotation()
+        state.annotate(annotation)
+    else:
+        annotation = annotations[0]
+    return annotation
+
+
+def get_ws_dependency_annotation(state: GlobalState) -> WSDependencyAnnotation:
+    annotations = cast(
+        List[WSDependencyAnnotation],
+        list(state.world_state.get_annotations(WSDependencyAnnotation)),
+    )
+    if len(annotations) == 0:
+        annotation = WSDependencyAnnotation()
+        state.world_state.annotate(annotation)
+    else:
+        annotation = annotations[0]
+    return annotation
+
+
+class DependencyPruner(LaserPlugin):
+    """Skips blocks with no dependency on the previous transaction's writes."""
+
+    def __init__(self):
+        self._reset()
+
+    def _reset(self):
+        self.iteration = 0
+        self.calls_on_path: Dict[int, bool] = {}
+        self.sloads_on_path: Dict[int, List[object]] = {}
+        self.sstores_on_path: Dict[int, List[object]] = {}
+        self.storage_accessed_global: Set = set()
+
+    def update_sloads(self, path: List[int], target_location: object) -> None:
+        for address in path:
+            if address in self.sloads_on_path:
+                if target_location not in self.sloads_on_path[address]:
+                    self.sloads_on_path[address].append(target_location)
+            else:
+                self.sloads_on_path[address] = [target_location]
+
+    def update_sstores(self, path: List[int], target_location: object) -> None:
+        for address in path:
+            if address in self.sstores_on_path:
+                if target_location not in self.sstores_on_path[address]:
+                    self.sstores_on_path[address].append(target_location)
+            else:
+                self.sstores_on_path[address] = [target_location]
+
+    def update_calls(self, path: List[int]) -> None:
+        for address in path:
+            if address in self.sstores_on_path:
+                self.calls_on_path[address] = True
+
+    def wanna_execute(self, address: int, annotation: DependencyAnnotation) -> bool:
+        """Whether the block starting at `address` may depend on the previous
+        transaction's storage writes."""
+        storage_write_cache = annotation.get_storage_write_cache(self.iteration - 1)
+
+        if address in self.calls_on_path:
+            return True
+        if address not in self.sloads_on_path:
+            return False  # "pure" path with no dependencies
+
+        if address in self.storage_accessed_global:
+            for location in self.sstores_on_path:
+                try:
+                    solver.get_model((location == address,))
+                    return True
+                except UnsatError:
+                    continue
+
+        dependencies = self.sloads_on_path[address]
+        for location in storage_write_cache:
+            for dependency in dependencies:
+                try:
+                    solver.get_model((location == dependency,))
+                    return True
+                except UnsatError:
+                    continue
+            for dependency in annotation.storage_loaded:
+                try:
+                    solver.get_model((location == dependency,))
+                    return True
+                except UnsatError:
+                    continue
+        return False
+
+    def initialize(self, symbolic_vm) -> None:
+        self._reset()
+
+        @symbolic_vm.laser_hook("start_sym_trans")
+        def start_sym_trans_hook():
+            self.iteration += 1
+
+        @symbolic_vm.post_hook("JUMP")
+        def jump_hook(state: GlobalState):
+            address = state.get_current_instruction()["address"]
+            annotation = get_dependency_annotation(state)
+            annotation.path.append(address)
+            _check_basic_block(address, annotation)
+
+        @symbolic_vm.post_hook("JUMPI")
+        def jumpi_hook(state: GlobalState):
+            address = state.get_current_instruction()["address"]
+            annotation = get_dependency_annotation(state)
+            annotation.path.append(address)
+            _check_basic_block(address, annotation)
+
+        @symbolic_vm.pre_hook("SSTORE")
+        def sstore_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            location = state.mstate.stack[-1]
+            self.update_sstores(annotation.path, location)
+            annotation.extend_storage_write_cache(self.iteration, location)
+
+        @symbolic_vm.pre_hook("SLOAD")
+        def sload_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            location = state.mstate.stack[-1]
+            if location not in annotation.storage_loaded:
+                annotation.storage_loaded.append(location)
+            # backwards-annotate: execution may never reach a STOP/RETURN
+            self.update_sloads(annotation.path, location)
+            self.storage_accessed_global.add(location)
+
+        @symbolic_vm.pre_hook("CALL")
+        def call_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            self.update_calls(annotation.path)
+            annotation.has_call = True
+
+        @symbolic_vm.pre_hook("STATICCALL")
+        def staticcall_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            self.update_calls(annotation.path)
+            annotation.has_call = True
+
+        @symbolic_vm.pre_hook("STOP")
+        def stop_hook(state: GlobalState):
+            _transaction_end(state)
+
+        @symbolic_vm.pre_hook("RETURN")
+        def return_hook(state: GlobalState):
+            _transaction_end(state)
+
+        def _transaction_end(state: GlobalState) -> None:
+            annotation = get_dependency_annotation(state)
+            for index in annotation.storage_loaded:
+                self.update_sloads(annotation.path, index)
+            for index in annotation.storage_written:
+                self.update_sstores(annotation.path, index)
+            if annotation.has_call:
+                self.update_calls(annotation.path)
+
+        def _check_basic_block(address: int, annotation: DependencyAnnotation):
+            if self.iteration < 2:
+                return
+            if address not in annotation.blocks_seen:
+                annotation.blocks_seen.add(address)
+                return
+            if self.wanna_execute(address, annotation):
+                return
+            log.debug(
+                "Skipping state: storage slots %s not read in block at address %d",
+                annotation.get_storage_write_cache(self.iteration - 1),
+                address,
+            )
+            raise PluginSkipState
+
+        @symbolic_vm.laser_hook("add_world_state")
+        def world_state_filter_hook(state: GlobalState):
+            if isinstance(state.current_transaction, ContractCreationTransaction):
+                self.iteration = 0
+                return
+            world_state_annotation = get_ws_dependency_annotation(state)
+            annotation = get_dependency_annotation(state)
+            # keep storage_written for the next transaction; reset the rest
+            annotation.path = [0]
+            annotation.storage_loaded = []
+            world_state_annotation.annotations_stack.append(annotation)

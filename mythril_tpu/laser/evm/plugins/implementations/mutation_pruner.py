@@ -1,0 +1,58 @@
+"""Mutation pruner (reference surface:
+mythril/laser/ethereum/plugins/implementations/mutation_pruner.py).
+
+A transaction that performs no state mutation and provably transfers no
+value leads to a world state equivalent to its predecessor; such "clean"
+world states are dropped to inhibit path explosion."""
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.plugins.implementations.plugin_annotations import (
+    MutationAnnotation,
+)
+from mythril_tpu.laser.evm.plugins.plugin import LaserPlugin
+from mythril_tpu.laser.evm.plugins.signals import PluginSkipWorldState
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.transaction.transaction_models import (
+    ContractCreationTransaction,
+)
+from mythril_tpu.smt import UGT, symbol_factory
+
+
+class MutationPruner(LaserPlugin):
+    """Drops open world states whose transaction neither mutated state nor
+    could have transferred value."""
+
+    def initialize(self, symbolic_vm):
+        @symbolic_vm.pre_hook("SSTORE")
+        def sstore_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.pre_hook("CALL")
+        def call_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.pre_hook("STATICCALL")
+        def staticcall_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.laser_hook("add_world_state")
+        def world_state_filter_hook(global_state: GlobalState):
+            if isinstance(global_state.current_transaction, ContractCreationTransaction):
+                return
+            if isinstance(global_state.environment.callvalue, int):
+                callvalue = symbol_factory.BitVecVal(
+                    global_state.environment.callvalue, 256
+                )
+            else:
+                callvalue = global_state.environment.callvalue
+            try:
+                constraints = global_state.world_state.constraints + [
+                    UGT(callvalue, symbol_factory.BitVecVal(0, 256))
+                ]
+                solver.get_model(tuple(constraints))
+                return  # value transfer possible: the state mutates balances
+            except UnsatError:
+                pass
+            if len(list(global_state.get_annotations(MutationAnnotation))) == 0:
+                raise PluginSkipWorldState

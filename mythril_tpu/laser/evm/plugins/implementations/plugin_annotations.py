@@ -1,0 +1,58 @@
+"""Annotations used by the built-in laser plugins (reference surface:
+mythril/laser/ethereum/plugins/implementations/plugin_annotations.py)."""
+
+from copy import copy
+from typing import Dict, List, Set
+
+from mythril_tpu.laser.evm.state.annotation import StateAnnotation
+
+
+class MutationAnnotation(StateAnnotation):
+    """Annotation used by the mutation pruner to record state mutations."""
+
+    @property
+    def persist_over_calls(self) -> bool:
+        return True
+
+
+class DependencyAnnotation(StateAnnotation):
+    """Tracks read/write dependencies of the current path for the dependency
+    pruner."""
+
+    def __init__(self):
+        self.storage_loaded: List = []
+        self.storage_written: Dict[int, List] = {}
+        self.has_call: bool = False
+        self.path: List[int] = [0]
+        self.blocks_seen: Set[int] = set()
+
+    def __copy__(self):
+        result = DependencyAnnotation()
+        result.storage_loaded = copy(self.storage_loaded)
+        result.storage_written = copy(self.storage_written)
+        result.has_call = self.has_call
+        result.path = copy(self.path)
+        result.blocks_seen = copy(self.blocks_seen)
+        return result
+
+    def get_storage_write_cache(self, iteration: int):
+        return self.storage_written.get(iteration, [])
+
+    def extend_storage_write_cache(self, iteration: int, value):
+        if iteration not in self.storage_written:
+            self.storage_written[iteration] = []
+        if value not in self.storage_written[iteration]:
+            self.storage_written[iteration].append(value)
+
+
+class WSDependencyAnnotation(StateAnnotation):
+    """Carries a stack of dependency annotations across transactions on the
+    world state."""
+
+    def __init__(self):
+        self.annotations_stack: List = []
+
+    def __copy__(self):
+        result = WSDependencyAnnotation()
+        result.annotations_stack = copy(self.annotations_stack)
+        return result

@@ -172,7 +172,9 @@ class WorldState:
 
     def _generate_new_address(self, creator=None) -> BitVec:
         if creator:
-            address = "0x" + mk_contract_address(bytes.fromhex(creator[-40:]), 0).hex()
+            creator_hex = creator[2:] if creator.startswith("0x") else creator
+            creator_bytes = bytes.fromhex(creator_hex.zfill(40))
+            address = "0x" + mk_contract_address(creator_bytes, 0).hex()
             return symbol_factory.BitVecVal(int(address, 16), 256)
         while True:
             address = "0x" + "".join([str(hex(randint(0, 16)))[-1] for _ in range(40)])

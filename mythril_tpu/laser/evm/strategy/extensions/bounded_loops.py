@@ -1,0 +1,110 @@
+"""Loop-bound strategy decorator (reference surface:
+mythril/laser/ethereum/strategy/extensions/bounded_loops.py): detects a
+repeating suffix in the per-state jumpdest trace and skips states whose
+repeat count exceeds the bound."""
+
+import logging
+from copy import copy
+from typing import Dict, List, cast
+
+from mythril_tpu.laser.evm.state.annotation import StateAnnotation
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.strategy import BasicSearchStrategy
+from mythril_tpu.laser.evm.transaction import ContractCreationTransaction
+
+log = logging.getLogger(__name__)
+
+
+class JumpdestCountAnnotation(StateAnnotation):
+    """Tracks the addresses visited by a state."""
+
+    def __init__(self) -> None:
+        self._reached_count: Dict[int, int] = {}
+        self.trace: List[int] = []
+
+    def __copy__(self):
+        result = JumpdestCountAnnotation()
+        result._reached_count = copy(self._reached_count)
+        result.trace = copy(self.trace)
+        return result
+
+
+class BoundedLoopsStrategy(BasicSearchStrategy):
+    """Ignores states whose trace ends with more than `bound` repetitions of
+    the same address cycle."""
+
+    def __init__(self, super_strategy: BasicSearchStrategy, *args) -> None:
+        self.super_strategy = super_strategy
+        self.bound = args[0][0]
+        log.info("Loaded search strategy extension: Loop bounds (limit = %d)", self.bound)
+        BasicSearchStrategy.__init__(
+            self, super_strategy.work_list, super_strategy.max_depth
+        )
+
+    @staticmethod
+    def calculate_hash(i: int, j: int, trace: List[int]) -> int:
+        """Order-sensitive fingerprint of trace[i:j]."""
+        key = 0
+        for itr in range(i, j):
+            key |= trace[itr] << ((itr - i) * 8)
+        return key
+
+    @staticmethod
+    def count_key(trace: List[int], key: int, start: int, size: int) -> int:
+        """Number of contiguous repetitions of the cycle ending at start."""
+        count = 0
+        i = start
+        while i >= 0:
+            if BoundedLoopsStrategy.calculate_hash(i, i + size, trace) != key:
+                break
+            count += 1
+            i -= size
+        return count
+
+    def get_strategic_global_state(self) -> GlobalState:
+        while True:
+            state = self.super_strategy.get_strategic_global_state()
+
+            annotations = cast(
+                List[JumpdestCountAnnotation],
+                list(state.get_annotations(JumpdestCountAnnotation)),
+            )
+            if len(annotations) == 0:
+                annotation = JumpdestCountAnnotation()
+                state.annotate(annotation)
+            else:
+                annotation = annotations[0]
+
+            cur_instr = state.get_current_instruction()
+            annotation.trace.append(cur_instr["address"])
+
+            if cur_instr["opcode"].upper() != "JUMPDEST":
+                return state
+
+            # look for a repeating cycle at the tail of the trace
+            found = False
+            i = 0
+            for i in range(len(annotation.trace) - 3, 0, -1):
+                if (
+                    annotation.trace[i] == annotation.trace[-2]
+                    and annotation.trace[i + 1] == annotation.trace[-1]
+                ):
+                    found = True
+                    break
+
+            if found:
+                key = self.calculate_hash(i, len(annotation.trace) - 1, annotation.trace)
+                size = len(annotation.trace) - i - 1
+                count = self.count_key(annotation.trace, key, i, size)
+            else:
+                count = 0
+
+            # the creation transaction gets a higher bound for better odds
+            if isinstance(
+                state.current_transaction, ContractCreationTransaction
+            ) and count < max(8, self.bound):
+                return state
+            elif count > self.bound:
+                log.debug("Loop bound reached, skipping state")
+                continue
+            return state

@@ -1,0 +1,14 @@
+from mythril_tpu.laser.evm.transaction.transaction_models import (
+    BaseTransaction,
+    ContractCreationTransaction,
+    MessageCallTransaction,
+    TransactionEndSignal,
+    TransactionStartSignal,
+    get_next_transaction_id,
+    transfer_ether,
+)
+from mythril_tpu.laser.evm.transaction.symbolic import (
+    ACTORS,
+    execute_contract_creation,
+    execute_message_call,
+)

@@ -105,6 +105,7 @@ OPCODES: Dict[int, OpSpec] = {
     0xFF: _spec("SUICIDE", 1, 0, 5000, 30000),
 }
 
+OPCODES[0x5F] = _spec("PUSH0", 0, 1, 2)  # EIP-3855 (Shanghai)
 for _i in range(1, 33):
     OPCODES[0x5F + _i] = _spec("PUSH" + str(_i), 0, 1, 3)
 for _i in range(1, 17):
@@ -113,6 +114,11 @@ for _i in range(1, 17):
 
 # name -> byte
 reverse_opcodes: Dict[str, int] = {spec.name: byte for byte, spec in OPCODES.items()}
+
+# name -> spec, including names without a (single) byte of their own: the
+# disassembler emits "INVALID" for undefined bytes
+NAME_SPECS: Dict[str, OpSpec] = {spec.name: spec for spec in OPCODES.values()}
+NAME_SPECS["INVALID"] = _spec("INVALID", 0, 0, 0)
 
 # compatibility view mirroring the reference's {byte: (name, pops, pushes, gas)}
 opcodes: Dict[int, Tuple[str, int, int, int]] = {
@@ -148,12 +154,12 @@ def ceil32(x: int) -> int:
 
 
 def get_opcode_gas(opcode: str) -> Tuple[int, int]:
-    spec = OPCODES[reverse_opcodes[opcode]]
+    spec = NAME_SPECS[opcode]
     return spec.min_gas, spec.max_gas
 
 
 def get_required_stack_elements(opcode: str) -> int:
-    return OPCODES[reverse_opcodes[opcode]].pops
+    return NAME_SPECS[opcode].pops
 
 
 def calculate_sha3_gas(length: int) -> Tuple[int, int]:
