@@ -1,0 +1,6 @@
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.analysis.module.util import (
+    get_detection_module_hooks,
+    reset_callback_modules,
+)

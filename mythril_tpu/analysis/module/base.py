@@ -1,0 +1,60 @@
+"""Detection module interface (reference surface:
+mythril/analysis/module/base.py). Modules are CALLBACK-style (hooked on
+opcodes during execution) or POST-style (scan the finished statespace)."""
+
+import logging
+from abc import ABC, abstractmethod
+from enum import Enum
+from typing import List, Optional, Set
+
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+
+log = logging.getLogger(__name__)
+
+
+class EntryPoint(Enum):
+    """POST modules scan the statespace after execution; CALLBACK modules
+    hook opcodes during execution (much faster)."""
+
+    POST = 1
+    CALLBACK = 2
+
+
+class DetectionModule(ABC):
+    """Base detection module.
+
+    Class properties: name, swc_id, description, entry_point,
+    pre_hooks/post_hooks (opcode lists; a trailing * matches prefixes)."""
+
+    name = "Detection Module Name / Title"
+    swc_id = "SWC-000"
+    description = "Detection module description"
+    entry_point: EntryPoint = EntryPoint.CALLBACK
+    pre_hooks: List[str] = []
+    post_hooks: List[str] = []
+
+    def __init__(self) -> None:
+        self.issues: List[Issue] = []
+        self.cache: Set[int] = set()
+
+    def reset_module(self):
+        self.issues = []
+
+    def execute(self, target: GlobalState) -> Optional[List[Issue]]:
+        """Entry point called by the engine's hooks."""
+        log.debug("Entering analysis module: %s", self.__class__.__name__)
+        result = self._execute(target)
+        log.debug("Exiting analysis module: %s", self.__class__.__name__)
+        return result
+
+    @abstractmethod
+    def _execute(self, target) -> Optional[List[Issue]]:
+        """Module main method (override this)."""
+
+    def __repr__(self) -> str:
+        return (
+            "<DetectionModule name={0.name} swc_id={0.swc_id} "
+            "pre_hooks={0.pre_hooks} post_hooks={0.post_hooks} "
+            "description={0.description}>"
+        ).format(self)

@@ -1,0 +1,16 @@
+"""Helpers for detection modules (reference surface:
+mythril/analysis/module/module_helpers.py)."""
+
+import traceback
+
+
+def is_prehook() -> bool:
+    """Whether the current callback was invoked from a pre-hook (inspects the
+    call stack for the engine's hook dispatcher)."""
+    stack = traceback.format_stack()[-8:]
+    for frame in reversed(stack):
+        if "_execute_pre_hook" in frame:
+            return True
+        if "_execute_post_hook" in frame:
+            return False
+    return False

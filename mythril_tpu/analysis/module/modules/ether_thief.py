@@ -1,0 +1,82 @@
+"""SWC-105: unprotected ether withdrawal (reference surface:
+mythril/analysis/module/modules/ether_thief.py): a valid end state where the
+attacker's balance strictly increased."""
+
+import logging
+from copy import copy
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
+from mythril_tpu.analysis.swc_data import UNPROTECTED_ETHER_WITHDRAWAL
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.transaction.symbolic import ACTORS
+from mythril_tpu.smt import UGT
+
+log = logging.getLogger(__name__)
+
+DESCRIPTION = """
+Search for cases where Ether can be withdrawn to a user-specified address.
+An issue is reported if there is a valid end state where the attacker has
+successfully increased their Ether balance.
+"""
+
+
+class EtherThief(DetectionModule):
+    """Searches for profitable ether extraction by arbitrary senders."""
+
+    name = "Any sender can withdraw ETH from the contract account"
+    swc_id = UNPROTECTED_ETHER_WITHDRAWAL
+    description = DESCRIPTION
+    entry_point = EntryPoint.CALLBACK
+    post_hooks = ["CALL", "STATICCALL"]
+
+    def _execute(self, state: GlobalState) -> None:
+        if state.get_current_instruction()["address"] in self.cache:
+            return
+        potential_issues = self._analyze_state(state)
+        annotation = get_potential_issues_annotation(state)
+        annotation.potential_issues.extend(potential_issues)
+
+    def _analyze_state(self, state):
+        state = copy(state)
+        instruction = state.get_current_instruction()
+
+        constraints = copy(state.world_state.constraints)
+        constraints += [
+            UGT(
+                state.world_state.balances[ACTORS.attacker],
+                state.world_state.starting_balances[ACTORS.attacker],
+            ),
+            state.environment.sender == ACTORS.attacker,
+            state.current_transaction.caller == state.current_transaction.origin,
+        ]
+
+        try:
+            # pre-solve: only record if the attacker's balance can increase
+            solver.get_model(constraints)
+            potential_issue = PotentialIssue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.environment.active_function_name,
+                address=instruction["address"] - 1,  # post-hook: previous instruction
+                swc_id=UNPROTECTED_ETHER_WITHDRAWAL,
+                title="Unprotected Ether Withdrawal",
+                severity="High",
+                bytecode=state.environment.code.bytecode,
+                description_head="Any sender can withdraw Ether from the contract account.",
+                description_tail="Arbitrary senders other than the contract creator can profitably extract Ether "
+                "from the contract account. Verify the business logic carefully and make sure that appropriate "
+                "security controls are in place to prevent unexpected loss of funds.",
+                detector=self,
+                constraints=constraints,
+            )
+            return [potential_issue]
+        except UnsatError:
+            return []
+
+
+detector = EtherThief()

@@ -1,0 +1,289 @@
+"""SWC-101: integer overflow/underflow (reference surface:
+mythril/analysis/module/modules/integer.py).
+
+Overflow conditions are attached as expression annotations where arithmetic
+happens; when a tainted value reaches a sink (SSTORE/JUMPI/CALL/RETURN) the
+condition is solved together with the path constraints at transaction end."""
+
+import logging
+from copy import copy
+from math import ceil, log2
+from typing import List, Set, cast
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.swc_data import INTEGER_OVERFLOW_AND_UNDERFLOW
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.state.annotation import StateAnnotation
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.smt import (
+    And,
+    BVAddNoOverflow,
+    BVMulNoOverflow,
+    BVSubNoUnderflow,
+    BitVec,
+    Bool,
+    Expression,
+    If,
+    Not,
+    UGE,
+    UGT,
+    symbol_factory,
+)
+
+log = logging.getLogger(__name__)
+
+
+class OverUnderflowAnnotation:
+    """Expression annotation: this value may have overflowed."""
+
+    def __init__(self, overflowing_state: GlobalState, operator: str, constraint: Bool) -> None:
+        self.overflowing_state = overflowing_state
+        self.operator = operator
+        self.constraint = constraint
+
+    def __deepcopy__(self, memodict=None):
+        return copy(self)
+
+
+class OverUnderflowStateAnnotation(StateAnnotation):
+    """State annotation: overflowed values used along the annotated path."""
+
+    def __init__(self) -> None:
+        self.overflowing_state_annotations: Set[OverUnderflowAnnotation] = set()
+
+    def __copy__(self):
+        new_annotation = OverUnderflowStateAnnotation()
+        new_annotation.overflowing_state_annotations = copy(
+            self.overflowing_state_annotations
+        )
+        return new_annotation
+
+
+class IntegerArithmetics(DetectionModule):
+    """Searches for integer over- and underflows."""
+
+    name = "Integer overflow or underflow"
+    swc_id = INTEGER_OVERFLOW_AND_UNDERFLOW
+    description = (
+        "For every SUB instruction, check if there's a possible state "
+        "where op1 > op0. For every ADD, MUL instruction, check if "
+        "there's a possible state where op1 + op0 > 2^256 - 1"
+    )
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = [
+        "ADD",
+        "MUL",
+        "EXP",
+        "SUB",
+        "SSTORE",
+        "JUMPI",
+        "STOP",
+        "RETURN",
+        "CALL",
+    ]
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._ostates_satisfiable: Set[GlobalState] = set()
+        self._ostates_unsatisfiable: Set[GlobalState] = set()
+
+    def reset_module(self):
+        super().reset_module()
+        self._ostates_satisfiable = set()
+        self._ostates_unsatisfiable = set()
+
+    def _execute(self, state: GlobalState) -> None:
+        address = _get_address_from_state(state)
+        if address in self.cache:
+            return
+        opcode = state.get_current_instruction()["opcode"]
+        funcs = {
+            "ADD": [self._handle_add],
+            "SUB": [self._handle_sub],
+            "MUL": [self._handle_mul],
+            "SSTORE": [self._handle_sstore],
+            "JUMPI": [self._handle_jumpi],
+            "CALL": [self._handle_call],
+            "RETURN": [self._handle_return, self._handle_transaction_end],
+            "STOP": [self._handle_transaction_end],
+            "EXP": [self._handle_exp],
+        }
+        for func in funcs[opcode]:
+            func(state)
+
+    def _get_args(self, state):
+        stack = state.mstate.stack
+        op0, op1 = (
+            self._make_bitvec_if_not(stack, -1),
+            self._make_bitvec_if_not(stack, -2),
+        )
+        return op0, op1
+
+    def _handle_add(self, state):
+        op0, op1 = self._get_args(state)
+        c = Not(BVAddNoOverflow(op0, op1, False))
+        op0.annotate(OverUnderflowAnnotation(state, "addition", c))
+
+    def _handle_mul(self, state):
+        op0, op1 = self._get_args(state)
+        c = Not(BVMulNoOverflow(op0, op1, False))
+        op0.annotate(OverUnderflowAnnotation(state, "multiplication", c))
+
+    def _handle_sub(self, state):
+        op0, op1 = self._get_args(state)
+        c = Not(BVSubNoUnderflow(op0, op1, False))
+        op0.annotate(OverUnderflowAnnotation(state, "subtraction", c))
+
+    def _handle_exp(self, state):
+        op0, op1 = self._get_args(state)
+        if op0.symbolic and op1.symbolic:
+            constraint = And(
+                UGT(op1, symbol_factory.BitVecVal(256, 256)),
+                UGT(op0, symbol_factory.BitVecVal(1, 256)),
+            )
+        elif op1.symbolic:
+            if op0.value < 2:
+                return
+            constraint = UGE(
+                op1, symbol_factory.BitVecVal(ceil(256 / log2(op0.value)), 256)
+            )
+        elif op0.symbolic:
+            if op1.value == 0:
+                return
+            exp = ceil(256 / op1.value)
+            if exp > 256:
+                return
+            constraint = UGE(op0, symbol_factory.BitVecVal(2**exp, 256))
+        else:
+            # concrete: overflow iff op1 * log2(op0) >= 256 (op0 >= 2)
+            overflows = op0.value >= 2 and op1.value * log2(op0.value) >= 256
+            constraint = symbol_factory.Bool(bool(overflows))
+        op0.annotate(OverUnderflowAnnotation(state, "exponentiation", constraint))
+
+    @staticmethod
+    def _make_bitvec_if_not(stack, index):
+        value = stack[index]
+        if isinstance(value, BitVec):
+            return value
+        if isinstance(value, Bool):
+            return If(value, 1, 0)
+        stack[index] = symbol_factory.BitVecVal(value, 256)
+        return stack[index]
+
+    @staticmethod
+    def _get_description_head(annotation, _type):
+        return "The binary {} can {}.".format(annotation.operator, _type.lower())
+
+    @staticmethod
+    def _get_description_tail(annotation, _type):
+        return (
+            "It is possible to cause an integer {} in the {} operation. Prevent the {} by constraining inputs "
+            "using the require() statement or use the OpenZeppelin SafeMath library for integer arithmetic operations. "
+            "Refer to the transaction trace generated for this issue to reproduce the {}.".format(
+                _type.lower(), annotation.operator, _type.lower(), _type.lower()
+            )
+        )
+
+    @staticmethod
+    def _get_title(_type):
+        return "Integer {}".format(_type)
+
+    @staticmethod
+    def _handle_sstore(state: GlobalState) -> None:
+        stack = state.mstate.stack
+        value = stack[-2]
+        if not isinstance(value, Expression):
+            return
+        state_annotation = _get_overflowunderflow_state_annotation(state)
+        for annotation in value.annotations:
+            if isinstance(annotation, OverUnderflowAnnotation):
+                state_annotation.overflowing_state_annotations.add(annotation)
+
+    @staticmethod
+    def _handle_jumpi(state):
+        stack = state.mstate.stack
+        value = stack[-2]
+        state_annotation = _get_overflowunderflow_state_annotation(state)
+        for annotation in value.annotations:
+            if isinstance(annotation, OverUnderflowAnnotation):
+                state_annotation.overflowing_state_annotations.add(annotation)
+
+    @staticmethod
+    def _handle_call(state):
+        stack = state.mstate.stack
+        value = stack[-3]
+        state_annotation = _get_overflowunderflow_state_annotation(state)
+        for annotation in value.annotations:
+            if isinstance(annotation, OverUnderflowAnnotation):
+                state_annotation.overflowing_state_annotations.add(annotation)
+
+    @staticmethod
+    def _handle_return(state: GlobalState) -> None:
+        stack = state.mstate.stack
+        offset, length = stack[-1], stack[-2]
+        state_annotation = _get_overflowunderflow_state_annotation(state)
+        for element in state.mstate.memory[offset : offset + length]:
+            if not isinstance(element, Expression):
+                continue
+            for annotation in element.annotations:
+                if isinstance(annotation, OverUnderflowAnnotation):
+                    state_annotation.overflowing_state_annotations.add(annotation)
+
+    def _handle_transaction_end(self, state: GlobalState) -> None:
+        state_annotation = _get_overflowunderflow_state_annotation(state)
+        for annotation in state_annotation.overflowing_state_annotations:
+            ostate = annotation.overflowing_state
+            if ostate in self._ostates_unsatisfiable:
+                continue
+            if ostate not in self._ostates_satisfiable:
+                try:
+                    constraints = ostate.world_state.constraints + [annotation.constraint]
+                    solver.get_model(constraints)
+                    self._ostates_satisfiable.add(ostate)
+                except Exception:
+                    self._ostates_unsatisfiable.add(ostate)
+                    continue
+            try:
+                constraints = state.world_state.constraints + [annotation.constraint]
+                transaction_sequence = solver.get_transaction_sequence(state, constraints)
+            except UnsatError:
+                continue
+
+            _type = "Underflow" if annotation.operator == "subtraction" else "Overflow"
+            issue = Issue(
+                contract=ostate.environment.active_account.contract_name,
+                function_name=ostate.environment.active_function_name,
+                address=ostate.get_current_instruction()["address"],
+                swc_id=INTEGER_OVERFLOW_AND_UNDERFLOW,
+                bytecode=ostate.environment.code.bytecode,
+                title=self._get_title(_type),
+                severity="High",
+                description_head=self._get_description_head(annotation, _type),
+                description_tail=self._get_description_tail(annotation, _type),
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                transaction_sequence=transaction_sequence,
+            )
+            address = _get_address_from_state(ostate)
+            self.cache.add(address)
+            self.issues.append(issue)
+
+
+detector = IntegerArithmetics()
+
+
+def _get_address_from_state(state):
+    return state.get_current_instruction()["address"]
+
+
+def _get_overflowunderflow_state_annotation(state: GlobalState) -> OverUnderflowStateAnnotation:
+    state_annotations = cast(
+        List[OverUnderflowStateAnnotation],
+        list(state.get_annotations(OverUnderflowStateAnnotation)),
+    )
+    if len(state_annotations) == 0:
+        state_annotation = OverUnderflowStateAnnotation()
+        state.annotate(state_annotation)
+        return state_annotation
+    return state_annotations[0]

@@ -1,0 +1,102 @@
+"""SWC-106: unprotected SELFDESTRUCT (reference surface:
+mythril/analysis/module/modules/suicide.py)."""
+
+import logging
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.swc_data import UNPROTECTED_SELFDESTRUCT
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.transaction.symbolic import ACTORS
+from mythril_tpu.laser.evm.transaction.transaction_models import (
+    ContractCreationTransaction,
+)
+from mythril_tpu.smt import And
+
+log = logging.getLogger(__name__)
+
+DESCRIPTION = """
+Check if the contract can be 'accidentally' killed by anyone.
+For kill-able contracts, also check whether it is possible to direct the
+contract balance to the attacker.
+"""
+
+
+class AccidentallyKillable(DetectionModule):
+    """Detects SELFDESTRUCT instructions reachable by any sender."""
+
+    name = "Contract can be accidentally killed by anyone"
+    swc_id = UNPROTECTED_SELFDESTRUCT
+    description = DESCRIPTION
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["SUICIDE"]
+
+    def _execute(self, state: GlobalState) -> None:
+        if state.get_current_instruction()["address"] in self.cache:
+            return
+        issues = self._analyze_state(state)
+        for issue in issues:
+            self.cache.add(issue.address)
+        self.issues.extend(issues)
+
+    @staticmethod
+    def _analyze_state(state):
+        log.debug("Suicide module: Analyzing suicide instruction")
+        instruction = state.get_current_instruction()
+        to = state.mstate.stack[-1]
+
+        description_head = "Any sender can cause the contract to self-destruct."
+
+        constraints = []
+        for tx in state.world_state.transaction_sequence:
+            if not isinstance(tx, ContractCreationTransaction):
+                constraints.append(
+                    And(tx.caller == ACTORS.attacker, tx.caller == tx.origin)
+                )
+        try:
+            try:
+                # strongest variant first: balance went to the attacker
+                transaction_sequence = solver.get_transaction_sequence(
+                    state,
+                    state.world_state.constraints
+                    + constraints
+                    + [to == ACTORS.attacker],
+                )
+                description_tail = (
+                    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
+                    "contract account and withdraw its balance to an arbitrary address. Review the transaction trace "
+                    "generated for this issue and make sure that appropriate security controls are in place to prevent "
+                    "unrestricted access."
+                )
+            except UnsatError:
+                transaction_sequence = solver.get_transaction_sequence(
+                    state, state.world_state.constraints + constraints
+                )
+                description_tail = (
+                    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
+                    "contract account. Review the transaction trace generated for this issue and make sure that "
+                    "appropriate security controls are in place to prevent unrestricted access."
+                )
+
+            issue = Issue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.environment.active_function_name,
+                address=instruction["address"],
+                swc_id=UNPROTECTED_SELFDESTRUCT,
+                bytecode=state.environment.code.bytecode,
+                title="Unprotected Selfdestruct",
+                severity="High",
+                description_head=description_head,
+                description_tail=description_tail,
+                transaction_sequence=transaction_sequence,
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            )
+            return [issue]
+        except UnsatError:
+            log.debug("No model found")
+        return []
+
+
+detector = AccidentallyKillable()

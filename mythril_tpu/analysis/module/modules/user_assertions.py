@@ -1,0 +1,87 @@
+"""SWC-110: user-defined assertion failures (reference surface:
+mythril/analysis/module/modules/user_assertions.py): detects
+`emit AssertionFailed(string)` events."""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
+from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
+from mythril_tpu.laser.evm import util
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+
+log = logging.getLogger(__name__)
+
+assertion_failed_hash = (
+    0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0
+)
+
+
+def _decode_abi_string(memory, start: int, size: int):
+    """Decode an ABI-encoded string from memory (no eth_abi dependency);
+    returns None if any byte is symbolic."""
+    try:
+        length = util.get_concrete_int(memory.get_word_at(start + 32))
+        raw = memory[start + 64 : start + 64 + length]
+        data = bytes(util.get_concrete_int(b) for b in raw)
+        return data.decode("utf8", errors="replace")
+    except (TypeError, IndexError):
+        return None
+
+
+class UserAssertions(DetectionModule):
+    """Searches for user-supplied exceptions: emit AssertionFailed("Error")."""
+
+    name = "A user-defined assertion has been triggered"
+    swc_id = ASSERT_VIOLATION
+    description = "Search for reachable user-supplied exceptions (AssertionFailed events)."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["LOG1"]
+
+    def _execute(self, state: GlobalState) -> None:
+        potential_issues = self._analyze_state(state)
+        annotation = get_potential_issues_annotation(state)
+        annotation.potential_issues.extend(potential_issues)
+
+    def _analyze_state(self, state: GlobalState):
+        topic, size, mem_start = state.mstate.stack[-3:]
+
+        if topic.symbolic or topic.value != assertion_failed_hash:
+            return []
+
+        message = None
+        if not mem_start.symbolic and not size.symbolic:
+            message = _decode_abi_string(
+                state.mstate.memory, mem_start.value, size.value
+            )
+
+        description_head = "A user-provided assertion failed."
+        if message:
+            description_tail = "A user-provided assertion failed with the message '{}'".format(
+                message
+            )
+        else:
+            description_tail = "A user-provided assertion failed."
+
+        address = state.get_current_instruction()["address"]
+        return [
+            PotentialIssue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.environment.active_function_name,
+                address=address,
+                swc_id=ASSERT_VIOLATION,
+                title="Assertion Failed",
+                bytecode=state.environment.code.bytecode,
+                severity="Medium",
+                description_head=description_head,
+                description_tail=description_tail,
+                constraints=[],
+                detector=self,
+            )
+        ]
+
+
+detector = UserAssertions()

@@ -1,0 +1,71 @@
+"""Helpers for dealing with EVM operations in the statespace (reference
+surface: mythril/analysis/ops.py)."""
+
+from enum import Enum
+
+from mythril_tpu.laser.evm import util
+from mythril_tpu.smt import simplify
+
+
+class VarType(Enum):
+    """Whether a value is symbolic or concrete."""
+
+    SYMBOLIC = 1
+    CONCRETE = 2
+
+
+class Variable:
+    """A value together with its VarType."""
+
+    def __init__(self, val, _type):
+        self.val = val
+        self.type = _type
+
+    def __str__(self):
+        return str(self.val)
+
+
+def get_variable(i) -> Variable:
+    try:
+        return Variable(util.get_concrete_int(i), VarType.CONCRETE)
+    except TypeError:
+        return Variable(simplify(i), VarType.SYMBOLIC)
+
+
+class Op:
+    """Base type for operations referencing current node and state."""
+
+    def __init__(self, node, state, state_index):
+        self.node = node
+        self.state = state
+        self.state_index = state_index
+
+
+class Call(Op):
+    """A recorded CALL-family operation."""
+
+    def __init__(
+        self,
+        node,
+        state,
+        state_index,
+        _type,
+        to,
+        gas,
+        value=Variable(0, VarType.CONCRETE),
+        data=None,
+    ):
+        super().__init__(node, state, state_index)
+        self.to = to
+        self.gas = gas
+        self.type = _type
+        self.value = value
+        self.data = data
+
+
+class SStore(Op):
+    """A recorded SSTORE operation."""
+
+    def __init__(self, node, state, state_index, value):
+        super().__init__(node, state, state_index)
+        self.value = value

@@ -1,0 +1,37 @@
+"""Firing detection modules at the statespace (reference surface:
+mythril/analysis/security.py)."""
+
+import logging
+from typing import List, Optional
+
+from mythril_tpu.analysis.module.base import EntryPoint
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.analysis.module.util import reset_callback_modules
+from mythril_tpu.analysis.report import Issue
+
+log = logging.getLogger(__name__)
+
+
+def retrieve_callback_issues(white_list: Optional[List[str]] = None) -> List[Issue]:
+    """Issues discovered by callback-type detection modules."""
+    issues: List[Issue] = []
+    for module in ModuleLoader().get_detection_modules(
+        entry_point=EntryPoint.CALLBACK, white_list=white_list
+    ):
+        log.debug("Retrieving results for %s", module.name)
+        issues += module.issues
+    reset_callback_modules(module_names=white_list)
+    return issues
+
+
+def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issue]:
+    """Run POST modules over the statespace and collect callback issues."""
+    log.info("Starting analysis")
+    issues: List[Issue] = []
+    for module in ModuleLoader().get_detection_modules(
+        entry_point=EntryPoint.POST, white_list=white_list
+    ):
+        log.info("Executing %s", module.name)
+        issues += module.execute(statespace)
+    issues += retrieve_callback_issues(white_list)
+    return issues

@@ -1,0 +1,224 @@
+"""Wrapper around the engine for analysis purposes (reference surface:
+mythril/analysis/symbolic.py — SymExecWrapper): builds the LaserEVM with the
+chosen strategy, loads plugins, registers detection-module hooks, runs
+symbolic execution and post-collects Call ops for POST modules."""
+
+import logging
+from typing import List, Optional, Type, Union
+
+from mythril_tpu.analysis.module import (
+    EntryPoint,
+    ModuleLoader,
+    get_detection_module_hooks,
+)
+from mythril_tpu.analysis.ops import Call, VarType, get_variable
+from mythril_tpu.laser.evm import svm
+from mythril_tpu.laser.evm.iprof import InstructionProfiler
+from mythril_tpu.laser.evm.natives import PRECOMPILE_COUNT
+from mythril_tpu.laser.evm.plugins.plugin_factory import PluginFactory
+from mythril_tpu.laser.evm.plugins.plugin_loader import LaserPluginLoader
+from mythril_tpu.laser.evm.state.account import Account
+from mythril_tpu.laser.evm.state.world_state import WorldState
+from mythril_tpu.laser.evm.strategy.basic import (
+    BasicSearchStrategy,
+    BreadthFirstSearchStrategy,
+    DepthFirstSearchStrategy,
+    ReturnRandomNaivelyStrategy,
+    ReturnWeightedRandomStrategy,
+)
+from mythril_tpu.laser.evm.strategy.extensions.bounded_loops import (
+    BoundedLoopsStrategy,
+)
+from mythril_tpu.laser.evm.transaction.symbolic import ACTORS
+from mythril_tpu.smt import BitVec, symbol_factory
+
+log = logging.getLogger(__name__)
+
+
+class SymExecWrapper:
+    """Symbolically executes the code and pre-parses calls for POST modules."""
+
+    def __init__(
+        self,
+        contract,
+        address: Union[int, str, BitVec],
+        strategy: str,
+        dynloader=None,
+        max_depth: int = 22,
+        execution_timeout: Optional[int] = None,
+        loop_bound: int = 3,
+        create_timeout: Optional[int] = None,
+        transaction_count: int = 2,
+        modules: Optional[List[str]] = None,
+        compulsory_statespace: bool = True,
+        iprof: Optional[InstructionProfiler] = None,
+        disable_dependency_pruning: bool = False,
+        run_analysis_modules: bool = True,
+        enable_coverage_strategy: bool = False,
+        custom_modules_directory: str = "",
+    ):
+        if isinstance(address, str):
+            address = symbol_factory.BitVecVal(int(address, 16), 256)
+        if isinstance(address, int):
+            address = symbol_factory.BitVecVal(address, 256)
+
+        if strategy == "dfs":
+            s_strategy: Type[BasicSearchStrategy] = DepthFirstSearchStrategy
+        elif strategy == "bfs":
+            s_strategy = BreadthFirstSearchStrategy
+        elif strategy == "naive-random":
+            s_strategy = ReturnRandomNaivelyStrategy
+        elif strategy == "weighted-random":
+            s_strategy = ReturnWeightedRandomStrategy
+        elif strategy == "tpu-batch":
+            # the batched engine reuses BFS ordering on the host side; the
+            # batch scheduler lives in mythril_tpu/laser/tpu/engine.py
+            s_strategy = BreadthFirstSearchStrategy
+        else:
+            raise ValueError("Invalid strategy argument supplied")
+
+        creator_account = Account(
+            hex(ACTORS.creator.value), "", dynamic_loader=None, contract_name=None
+        )
+        attacker_account = Account(
+            hex(ACTORS.attacker.value), "", dynamic_loader=None, contract_name=None
+        )
+
+        requires_statespace = (
+            compulsory_statespace
+            or len(ModuleLoader().get_detection_modules(EntryPoint.POST, modules)) > 0
+        )
+        if not contract.creation_code:
+            self.accounts = {hex(ACTORS.attacker.value): attacker_account}
+        else:
+            self.accounts = {
+                hex(ACTORS.creator.value): creator_account,
+                hex(ACTORS.attacker.value): attacker_account,
+            }
+
+        instruction_laser_plugin = PluginFactory.build_instruction_coverage_plugin()
+
+        self.laser = svm.LaserEVM(
+            dynamic_loader=dynloader,
+            max_depth=max_depth,
+            execution_timeout=execution_timeout,
+            strategy=s_strategy,
+            create_timeout=create_timeout,
+            transaction_count=transaction_count,
+            requires_statespace=requires_statespace,
+            iprof=iprof,
+            enable_coverage_strategy=enable_coverage_strategy,
+            instruction_laser_plugin=instruction_laser_plugin,
+        )
+
+        if loop_bound is not None:
+            self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
+
+        plugin_loader = LaserPluginLoader(self.laser)
+        plugin_loader.load(PluginFactory.build_mutation_pruner_plugin())
+        plugin_loader.load(instruction_laser_plugin)
+        if not disable_dependency_pruning:
+            plugin_loader.load(PluginFactory.build_dependency_pruner_plugin())
+
+        world_state = WorldState()
+        for account in self.accounts.values():
+            world_state.put_account(account)
+
+        if run_analysis_modules:
+            analysis_modules = ModuleLoader().get_detection_modules(
+                EntryPoint.CALLBACK, modules
+            )
+            self.laser.register_hooks(
+                hook_type="pre",
+                hook_dict=get_detection_module_hooks(analysis_modules, hook_type="pre"),
+            )
+            self.laser.register_hooks(
+                hook_type="post",
+                hook_dict=get_detection_module_hooks(analysis_modules, hook_type="post"),
+            )
+
+        if hasattr(contract, "creation_code") and contract.creation_code:
+            self.laser.sym_exec(
+                creation_code=contract.creation_code,
+                contract_name=contract.name,
+                world_state=world_state,
+            )
+        else:
+            account = Account(
+                address,
+                contract.disassembly,
+                dynamic_loader=dynloader,
+                contract_name=contract.name,
+                balances=world_state.balances,
+                concrete_storage=True
+                if (dynloader is not None and dynloader.active)
+                else False,
+            )
+            if dynloader is not None:
+                try:
+                    addr_hex = (
+                        address
+                        if isinstance(address, str)
+                        else "{0:#0{1}x}".format(
+                            address if isinstance(address, int) else address.value, 42
+                        )
+                    )
+                    account.set_balance(dynloader.read_balance(addr_hex))
+                except Exception:
+                    pass  # initial balance stays symbolic
+            world_state.put_account(account)
+            self.laser.sym_exec(world_state=world_state, target_address=address.value)
+
+        if not requires_statespace:
+            return
+
+        self.nodes = self.laser.nodes
+        self.edges = self.laser.edges
+
+        # parse calls for easy access by POST modules
+        self.calls: List[Call] = []
+        for key in self.nodes:
+            state_index = 0
+            for state in self.nodes[key].states:
+                instruction = state.get_current_instruction()
+                op = instruction["opcode"]
+                if op in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"):
+                    stack = state.mstate.stack
+                    if op in ("CALL", "CALLCODE"):
+                        gas, to, value, meminstart, meminsz = (
+                            get_variable(stack[-1]),
+                            get_variable(stack[-2]),
+                            get_variable(stack[-3]),
+                            get_variable(stack[-4]),
+                            get_variable(stack[-5]),
+                        )
+                        if to.type == VarType.CONCRETE and 0 < to.val <= PRECOMPILE_COUNT:
+                            continue  # ignore precompiles
+                        if (
+                            meminstart.type == VarType.CONCRETE
+                            and meminsz.type == VarType.CONCRETE
+                        ):
+                            self.calls.append(
+                                Call(
+                                    self.nodes[key],
+                                    state,
+                                    state_index,
+                                    op,
+                                    to,
+                                    gas,
+                                    value,
+                                    state.mstate.memory[
+                                        meminstart.val : meminsz.val + meminstart.val
+                                    ],
+                                )
+                            )
+                        else:
+                            self.calls.append(
+                                Call(self.nodes[key], state, state_index, op, to, gas, value)
+                            )
+                    else:
+                        gas, to = get_variable(stack[-1]), get_variable(stack[-2])
+                        self.calls.append(
+                            Call(self.nodes[key], state, state_index, op, to, gas)
+                        )
+                state_index += 1
