@@ -40,6 +40,7 @@ class DetectionModule(ABC):
 
     def reset_module(self):
         self.issues = []
+        self.cache = set()
 
     def execute(self, target: GlobalState) -> Optional[List[Issue]]:
         """Entry point called by the engine's hooks."""

@@ -36,7 +36,9 @@ class EtherThief(DetectionModule):
     post_hooks = ["CALL", "STATICCALL"]
 
     def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
+        # post-hook: the cache is keyed on the call-site address (one before
+        # the current instruction), matching PotentialIssue.address below
+        if state.get_current_instruction()["address"] - 1 in self.cache:
             return
         potential_issues = self._analyze_state(state)
         annotation = get_potential_issues_annotation(state)

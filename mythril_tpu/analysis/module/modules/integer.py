@@ -153,7 +153,7 @@ class IntegerArithmetics(DetectionModule):
             if op1.value == 0:
                 return
             exp = ceil(256 / op1.value)
-            if exp > 256:
+            if exp >= 256:
                 return
             constraint = UGE(op0, symbol_factory.BitVecVal(2**exp, 256))
         else:

@@ -43,8 +43,6 @@ class UncheckedRetval(DetectionModule):
     post_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
 
     def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
         issues = self._analyze_state(state)
         for issue in issues:
             self.cache.add(issue.address)
@@ -68,6 +66,8 @@ class UncheckedRetval(DetectionModule):
         if instruction["opcode"] in ("STOP", "RETURN"):
             issues = []
             for retval in retvals:
+                if retval["address"] in self.cache:
+                    continue
                 try:
                     transaction_sequence = solver.get_transaction_sequence(
                         state, state.world_state.constraints + [retval["retval"] == 0]

@@ -25,6 +25,9 @@ def _decode_abi_string(memory, start: int, size: int):
     returns None if any byte is symbolic."""
     try:
         length = util.get_concrete_int(memory.get_word_at(start + 32))
+        # the LOG1 size operand bounds the event payload; never trust the
+        # in-memory length word alone (attacker-chosen, can be astronomical)
+        length = min(length, max(size - 64, 0))
         raw = memory[start + 64 : start + 64 + length]
         data = bytes(util.get_concrete_int(b) for b in raw)
         return data.decode("utf8", errors="replace")

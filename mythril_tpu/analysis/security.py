@@ -32,6 +32,6 @@ def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issu
         entry_point=EntryPoint.POST, white_list=white_list
     ):
         log.info("Executing %s", module.name)
-        issues += module.execute(statespace)
+        issues += module.execute(statespace) or []
     issues += retrieve_callback_issues(white_list)
     return issues

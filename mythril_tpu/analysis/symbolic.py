@@ -154,15 +154,9 @@ class SymExecWrapper:
                 if (dynloader is not None and dynloader.active)
                 else False,
             )
-            if dynloader is not None:
+            if dynloader is not None and address.value is not None:
                 try:
-                    addr_hex = (
-                        address
-                        if isinstance(address, str)
-                        else "{0:#0{1}x}".format(
-                            address if isinstance(address, int) else address.value, 42
-                        )
-                    )
+                    addr_hex = "{0:#0{1}x}".format(address.value, 42)
                     account.set_balance(dynloader.read_balance(addr_hex))
                 except Exception:
                     pass  # initial balance stays symbolic

@@ -15,6 +15,8 @@ class EVMContract:
     """A contract holding runtime and creation bytecode."""
 
     def __init__(self, code="", creation_code="", name="Unknown", enable_online_lookup=False):
+        code = _replace_library_placeholders(code)
+        creation_code = _replace_library_placeholders(creation_code)
         self.creation_code = creation_code
         self.name = name
         self.code = code

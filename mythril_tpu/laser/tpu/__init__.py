@@ -1,0 +1,14 @@
+"""TPU batch engine: vmapped symbolic EVM over structure-of-arrays state.
+
+This package is the TPU-native core that replaces the reference's
+per-object interpreter loop (mythril/laser/ethereum/svm.py:220 exec / one
+GlobalState at a time) with a batched, jittable step over thousands of
+path-lanes packed SoA in HBM:
+
+- words.py    — 256-bit EVM word arithmetic as 16x16-bit digit limbs (u32 lanes)
+- state.py    — the SoA state batch (pytree) incl. on-device expression table
+- step.py     — the fused one-instruction step kernel + JUMPI lane forking
+- engine.py   — host driver bridging the batch world to the LaserEVM API
+- solver_jax.py — batched tape evaluation / local-search witness finding
+- sharding.py — pjit/shard_map multi-chip path parallelism
+"""

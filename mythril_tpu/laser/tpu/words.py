@@ -1,0 +1,418 @@
+"""256-bit EVM word arithmetic for TPU: 16 LSB-first 16-bit digits in u32 lanes.
+
+The reference does all 256-bit arithmetic through z3 BitVec terms
+(mythril/laser/smt/bitvec.py) or python ints. On TPU there is no native
+wide integer, and 64-bit lanes are second-class, so a word is represented
+as ``u32[..., 16]`` where element ``i`` holds digit ``i`` (the *least*
+significant 16 bits first). Products of two digits fit exactly in u32
+(16x16 -> 32), which keeps every kernel in fast 32-bit VPU lanes with no
+x64 requirement.
+
+Every function is shape-polymorphic over leading batch axes and jittable;
+nothing here ever materialises a python int inside a trace. Host-side
+conversion helpers (``from_int``/``to_int``) are provided for tests and
+for the host <-> device boundary in engine.py.
+
+Semantics follow the EVM (yellow-paper) conventions used by the reference
+interpreter (mythril/laser/ethereum/instructions.py): DIV/MOD by zero is 0,
+SDIV overflow (-2^255 / -1) wraps, EXP is mod 2^256, shifts >= 256 give
+0 (or the sign-fill for SAR).
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NDIGITS = 16  # 256 bits / 16 bits per digit
+DIGIT_BITS = 16
+DIGIT_MASK = jnp.uint32(0xFFFF)
+U32 = jnp.uint32
+
+# ---------------------------------------------------------------------------
+# host <-> device conversion
+
+
+def from_int(x: int, dtype=np.uint32) -> np.ndarray:
+    """Python int -> digit vector (host helper)."""
+    x &= (1 << 256) - 1
+    return np.array([(x >> (DIGIT_BITS * i)) & 0xFFFF for i in range(NDIGITS)], dtype=dtype)
+
+
+def to_int(w) -> int:
+    """Digit vector -> python int (host helper)."""
+    w = np.asarray(w)
+    return sum(int(w[..., i]) << (DIGIT_BITS * i) for i in range(NDIGITS))
+
+
+def const(x: int):
+    return jnp.asarray(from_int(x))
+
+
+def zeros(batch_shape=()):
+    return jnp.zeros(batch_shape + (NDIGITS,), dtype=U32)
+
+
+def from_u32(x):
+    """u32 scalar/batch -> word. x occupies digits 0..1."""
+    x = x.astype(U32)
+    lo = x & DIGIT_MASK
+    hi = x >> DIGIT_BITS
+    pad = jnp.zeros(x.shape + (NDIGITS - 2,), dtype=U32)
+    return jnp.concatenate([lo[..., None], hi[..., None], pad], axis=-1)
+
+
+def to_u32(w):
+    """Low 32 bits of a word as u32 (for pc/offset/gas style uses)."""
+    return w[..., 0] | (w[..., 1] << DIGIT_BITS)
+
+
+def fits_u32(w):
+    """True where the word fits in 32 bits."""
+    return jnp.all(w[..., 2:] == 0, axis=-1)
+
+
+def from_bytes_be(b):
+    """u8[..., 32] big-endian bytes -> word."""
+    b = b.astype(U32)
+    # byte 31 is least significant; digit i = bytes (31-2i, 30-2i) -> hi,lo
+    lo = b[..., ::-1][..., 0::2]  # bytes 31,29,...  (low byte of each digit)
+    hi = b[..., ::-1][..., 1::2]  # bytes 30,28,...
+    return lo | (hi << 8)
+
+
+def to_bytes_be(w):
+    """word -> u8[..., 32] big-endian bytes (as u32 values 0..255)."""
+    lo = w & 0xFF
+    hi = (w >> 8) & 0xFF
+    # digit i -> bytes at positions 31-2i (lo) and 30-2i (hi)
+    interleaved = jnp.stack([lo, hi], axis=-1).reshape(w.shape[:-1] + (32,))
+    return interleaved[..., ::-1]
+
+
+# ---------------------------------------------------------------------------
+# bitwise
+
+
+def bit_and(a, b):
+    return a & b
+
+
+def bit_or(a, b):
+    return a | b
+
+
+def bit_xor(a, b):
+    return a ^ b
+
+
+def bit_not(a):
+    return (~a) & DIGIT_MASK
+
+
+# ---------------------------------------------------------------------------
+# add / sub
+
+
+def _ripple(digits_list):
+    """Carry-propagate a list of 16 u32 column sums (each < 2^31)."""
+    out = []
+    carry = jnp.zeros_like(digits_list[0])
+    for i in range(NDIGITS):
+        t = digits_list[i] + carry
+        out.append(t & DIGIT_MASK)
+        carry = t >> DIGIT_BITS
+    return jnp.stack(out, axis=-1), carry
+
+
+def add(a, b):
+    r, _ = _ripple([a[..., i] + b[..., i] for i in range(NDIGITS)])
+    return r
+
+
+def add_carry(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(a + b) mod 2^256 and the carry-out digit (0/1) — for ADDMOD."""
+    return _ripple([a[..., i] + b[..., i] for i in range(NDIGITS)])
+
+
+def sub(a, b):
+    # a - b = a + ~b + 1, fused into one ripple
+    cols = [a[..., i] + (DIGIT_MASK - b[..., i]) for i in range(NDIGITS)]
+    cols[0] = cols[0] + 1
+    r, _ = _ripple(cols)
+    return r
+
+
+def sub_borrow(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(a - b) mod 2^256 and borrow flag (1 where a < b)."""
+    cols = [a[..., i] + (DIGIT_MASK - b[..., i]) for i in range(NDIGITS)]
+    cols[0] = cols[0] + 1
+    r, carry = _ripple(cols)
+    return r, (carry == 0).astype(U32)
+
+
+# ---------------------------------------------------------------------------
+# comparison
+
+
+def ult(a, b):
+    return sub_borrow(a, b)[1] == 1
+
+
+def ugt(a, b):
+    return ult(b, a)
+
+
+def ule(a, b):
+    return ~ult(b, a)
+
+
+def uge(a, b):
+    return ~ult(a, b)
+
+
+def _flip_sign(a):
+    """XOR the 2^255 bit, mapping signed order onto unsigned order."""
+    top = a[..., NDIGITS - 1] ^ 0x8000
+    return jnp.concatenate([a[..., : NDIGITS - 1], top[..., None]], axis=-1)
+
+
+def slt(a, b):
+    return ult(_flip_sign(a), _flip_sign(b))
+
+
+def sgt(a, b):
+    return slt(b, a)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def bool_to_word(m):
+    """bool[...] -> word 0/1."""
+    w = jnp.zeros(m.shape + (NDIGITS,), dtype=U32)
+    return w.at[..., 0].set(m.astype(U32))
+
+
+def sign_bit(a):
+    return (a[..., NDIGITS - 1] >> 15) & 1
+
+
+# ---------------------------------------------------------------------------
+# multiplication
+
+
+def mul_full(a, b):
+    """Full 512-bit product as u32[..., 32] digits."""
+    # column sums of digit products, split lo/hi to stay within u32
+    lo_cols = [jnp.zeros(a.shape[:-1], dtype=U32) for _ in range(2 * NDIGITS)]
+    hi_cols = [jnp.zeros(a.shape[:-1], dtype=U32) for _ in range(2 * NDIGITS)]
+    for i in range(NDIGITS):
+        for j in range(NDIGITS):
+            p = a[..., i] * b[..., j]  # exact in u32
+            k = i + j
+            lo_cols[k] = lo_cols[k] + (p & DIGIT_MASK)
+            hi_cols[k + 1] = hi_cols[k + 1] + (p >> DIGIT_BITS)
+    # each lo_cols[k] <= 16 * 0xFFFF, hi likewise: sums < 2^21, safe
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=U32)
+    for k in range(2 * NDIGITS):
+        t = lo_cols[k] + hi_cols[k] + carry
+        out.append(t & DIGIT_MASK)
+        carry = t >> DIGIT_BITS
+    return jnp.stack(out, axis=-1)
+
+
+def mul(a, b):
+    return mul_full(a, b)[..., :NDIGITS]
+
+
+# ---------------------------------------------------------------------------
+# division (shift-subtract long division, jittable, batch-wide)
+
+
+def _divmod_wide(dividend, divisor, nbits: int):
+    """Long division: dividend u32[..., D] (D*16 >= nbits), divisor word.
+
+    Returns (quotient u32[..., D], remainder word). Caller handles /0.
+    """
+    ndig = dividend.shape[-1]
+
+    def body(i, carry):
+        quot, rem = carry
+        bit_index = nbits - 1 - i
+        d = bit_index // DIGIT_BITS
+        r = bit_index % DIGIT_BITS
+        bit = (jnp.take(dividend, d, axis=-1) >> r) & 1
+        # rem = (rem << 1) | bit; the shifted-out 257th bit means rem >= 2^256
+        # > divisor, so subtraction certainly fires and the mod-2^256 sub
+        # still produces the true (sub-2^256) remainder.
+        rem_hi = rem >> (DIGIT_BITS - 1)
+        overflow = rem_hi[..., -1] == 1
+        rem = ((rem << 1) & DIGIT_MASK).at[..., 0].add(bit)
+        rem = rem.at[..., 1:].add(rem_hi[..., :-1])
+        ge = overflow | uge(rem, divisor)
+        rem = jnp.where(ge[..., None], sub(rem, divisor), rem)
+        quot = quot.at[..., d].add(ge.astype(U32) << r)
+        return (quot, rem)
+
+    quot0 = jnp.zeros(dividend.shape[:-1] + (ndig,), dtype=U32)
+    rem0 = jnp.zeros(dividend.shape[:-1] + (NDIGITS,), dtype=U32)
+    quot, rem = jax.lax.fori_loop(0, nbits, body, (quot0, rem0))
+    return quot, rem
+
+
+def divmod256(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """EVM DIV/MOD: (a // b, a % b), both 0 when b == 0."""
+    q, r = _divmod_wide(a, b, 256)
+    bz = is_zero(b)[..., None]
+    return jnp.where(bz, 0, q), jnp.where(bz, 0, r)
+
+
+def udiv(a, b):
+    return divmod256(a, b)[0]
+
+
+def umod(a, b):
+    return divmod256(a, b)[1]
+
+
+def _abs_signed(a):
+    neg_mask = sign_bit(a) == 1
+    return jnp.where(neg_mask[..., None], sub(zeros(a.shape[:-1]), a), a), neg_mask
+
+
+def sdiv(a, b):
+    aa, an = _abs_signed(a)
+    bb, bn = _abs_signed(b)
+    q = udiv(aa, bb)
+    flip = an ^ bn
+    return jnp.where(flip[..., None], sub(zeros(a.shape[:-1]), q), q)
+
+
+def smod(a, b):
+    aa, an = _abs_signed(a)
+    bb, _ = _abs_signed(b)
+    r = umod(aa, bb)
+    return jnp.where(an[..., None], sub(zeros(a.shape[:-1]), r), r)
+
+
+def addmod(a, b, n):
+    """(a + b) mod n over 257-bit intermediate; 0 when n == 0."""
+    s, carry = add_carry(a, b)
+    wide = jnp.concatenate([s, carry[..., None], jnp.zeros(s.shape[:-1] + (NDIGITS - 1,), U32)], axis=-1)
+    _, r = _divmod_wide(wide, n, 257)
+    return jnp.where(is_zero(n)[..., None], 0, r)
+
+
+def mulmod(a, b, n):
+    """(a * b) mod n over 512-bit intermediate; 0 when n == 0."""
+    wide = mul_full(a, b)
+    _, r = _divmod_wide(wide, n, 512)
+    return jnp.where(is_zero(n)[..., None], 0, r)
+
+
+def exp(a, e):
+    """a ** e mod 2^256 via square-and-multiply over e's 256 bits."""
+
+    def body(i, carry):
+        result, base = carry
+        d = i // DIGIT_BITS
+        r = i % DIGIT_BITS
+        bit = (jnp.take(e, d, axis=-1) >> r) & 1
+        result = jnp.where((bit == 1)[..., None], mul(result, base), result)
+        base = mul(base, base)
+        return (result, base)
+
+    one = jnp.broadcast_to(const(1), a.shape)
+    result, _ = jax.lax.fori_loop(0, 256, body, (one, a))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# shifts
+
+
+def _shift_amount(s):
+    """Decompose shift word -> (digit shift, bit shift, overflow>=256 mask)."""
+    over = ~fits_u32(s) | (to_u32(s) >= 256)
+    amt = to_u32(s) & 0xFF
+    return amt // DIGIT_BITS, amt % DIGIT_BITS, over
+
+
+def shl(s, a):
+    d, r, over = _shift_amount(s)
+    k = jnp.arange(NDIGITS)
+    idx1 = k - d[..., None]
+    idx2 = idx1 - 1
+    a1 = jnp.where(idx1 >= 0, jnp.take_along_axis(a, jnp.clip(idx1, 0, NDIGITS - 1).astype(jnp.int32), axis=-1), 0)
+    a2 = jnp.where(idx2 >= 0, jnp.take_along_axis(a, jnp.clip(idx2, 0, NDIGITS - 1).astype(jnp.int32), axis=-1), 0)
+    res = ((a1 << r[..., None]) | (a2 >> (DIGIT_BITS - r[..., None]))) & DIGIT_MASK
+    return jnp.where(over[..., None], 0, res)
+
+
+def shr(s, a):
+    d, r, over = _shift_amount(s)
+    k = jnp.arange(NDIGITS)
+    idx1 = k + d[..., None]
+    idx2 = idx1 + 1
+    a1 = jnp.where(idx1 < NDIGITS, jnp.take_along_axis(a, jnp.clip(idx1, 0, NDIGITS - 1).astype(jnp.int32), axis=-1), 0)
+    a2 = jnp.where(idx2 < NDIGITS, jnp.take_along_axis(a, jnp.clip(idx2, 0, NDIGITS - 1).astype(jnp.int32), axis=-1), 0)
+    res = ((a1 >> r[..., None]) | (a2 << (DIGIT_BITS - r[..., None]))) & DIGIT_MASK
+    return jnp.where(over[..., None], 0, res)
+
+
+def sar(s, a):
+    neg_mask = sign_bit(a) == 1
+    fill = jnp.where(neg_mask[..., None], jnp.broadcast_to(DIGIT_MASK, a.shape), jnp.zeros_like(a))
+    d, r, over = _shift_amount(s)
+    k = jnp.arange(NDIGITS)
+    idx1 = k + d[..., None]
+    idx2 = idx1 + 1
+    ext = jnp.concatenate([a, fill], axis=-1)  # 32 digits: a then sign fill
+    a1 = jnp.take_along_axis(ext, jnp.clip(idx1, 0, 2 * NDIGITS - 1).astype(jnp.int32), axis=-1)
+    a2 = jnp.take_along_axis(ext, jnp.clip(idx2, 0, 2 * NDIGITS - 1).astype(jnp.int32), axis=-1)
+    res = ((a1 >> r[..., None]) | (a2 << (DIGIT_BITS - r[..., None]))) & DIGIT_MASK
+    return jnp.where(over[..., None], fill, res)
+
+
+# ---------------------------------------------------------------------------
+# byte / signextend
+
+
+def byte_word(i, w):
+    """BYTE returning a full word (low digit holds the byte)."""
+    iv = to_u32(i)
+    valid = fits_u32(i) & (iv < 32)
+    pos = (31 - jnp.clip(iv, 0, 31)) * 8
+    d = (pos // DIGIT_BITS).astype(jnp.int32)
+    r = pos % DIGIT_BITS
+    digit = jnp.take_along_axis(w, d[..., None], axis=-1)[..., 0]
+    byte = jnp.where(valid, (digit >> r) & 0xFF, 0)
+    out = jnp.zeros(w.shape, dtype=U32)
+    return out.at[..., 0].set(byte)
+
+
+def signextend(b, x):
+    """EVM SIGNEXTEND: sign-extend x from byte position b (0 = lowest byte)."""
+    bv = to_u32(b)
+    valid = fits_u32(b) & (bv < 31)
+    sign_pos = bv * 8 + 7  # bit index of the sign bit
+    d = (sign_pos // DIGIT_BITS).astype(jnp.int32)
+    r = sign_pos % DIGIT_BITS
+    digit = jnp.take_along_axis(x, d[..., None], axis=-1)[..., 0]
+    sbit = (digit >> r) & 1
+    # mask of bits <= sign_pos per digit
+    k = jnp.arange(NDIGITS)
+    # number of live bits in digit k: clamp(sign_pos+1 - 16k, 0, 16)
+    live = jnp.clip(sign_pos[..., None].astype(jnp.int32) + 1 - DIGIT_BITS * k, 0, DIGIT_BITS)
+    mask = jnp.where(live >= DIGIT_BITS, DIGIT_MASK, (U32(1) << live.astype(U32)) - 1)
+    ext = jnp.where((sbit == 1)[..., None], (x & mask) | (DIGIT_MASK & ~mask), x & mask)
+    return jnp.where(valid[..., None], ext, x)
