@@ -1,0 +1,227 @@
+"""Structure-of-arrays EVM state batch for the TPU interpreter.
+
+The reference holds one ``GlobalState`` per path as a Python object graph
+(mythril/laser/ethereum/state/global_state.py:21) and forks by deepcopy.
+Here a whole *population* of machine states lives as one pytree of dense
+arrays in HBM — lane ``i`` of every array is path ``i`` — so the step
+function vectorises across paths on the VPU and forking is a lane copy.
+
+Words are 16x16-bit digit vectors (laser/tpu/words.py). Memory and
+calldata are fixed-capacity byte planes with explicit lengths; storage is
+a per-lane associative array of (key, value) word pairs probed by linear
+scan (K slots, vectorised compare — the EVM touches only a handful of
+slots per path, and a miss traps the lane back to the host engine).
+
+Lanes carry a ``status`` machine word:
+  0 RUNNING   1 STOPPED    2 RETURNED   3 REVERTED
+  4 ERROR (invalid op / bad jump / stack fault / out-of-gas)
+  5 TRAP  — lane hit something the device kernel doesn't model
+            (CALL family, CREATE, storage overflow, oversized SHA3);
+            the host engine unpacks the lane and continues it symbolically.
+Dead lanes (alive=False) are free slots for JUMPI forking.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from mythril_tpu.laser.tpu import words
+
+RUNNING, STOPPED, RETURNED, REVERTED, ERROR, TRAP = range(6)
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+
+class BatchConfig(NamedTuple):
+    """Static capacities (shape parameters) of a state batch."""
+
+    lanes: int = 256
+    stack_slots: int = 64
+    memory_bytes: int = 4096
+    calldata_bytes: int = 512
+    storage_slots: int = 32
+    code_len: int = 8192
+
+
+class CodeBank(NamedTuple):
+    """Deduplicated bytecode plane shared by all lanes (lane -> code_id)."""
+
+    code: jnp.ndarray  # u8[n_codes, code_len]
+    code_len: jnp.ndarray  # i32[n_codes]
+    jumpdest: jnp.ndarray  # bool[n_codes, code_len] valid JUMPDEST targets
+
+
+class Env(NamedTuple):
+    """Block-level context shared by every lane (words, shape [16])."""
+
+    number: jnp.ndarray
+    timestamp: jnp.ndarray
+    coinbase: jnp.ndarray
+    difficulty: jnp.ndarray
+    gaslimit: jnp.ndarray
+    chainid: jnp.ndarray
+    basefee: jnp.ndarray
+    gasprice: jnp.ndarray
+    blockhash: jnp.ndarray  # single modeled hash for BLOCKHASH
+
+
+class StateBatch(NamedTuple):
+    alive: jnp.ndarray  # bool[L] lane holds a state
+    status: jnp.ndarray  # i32[L] RUNNING..TRAP
+    trap_op: jnp.ndarray  # i32[L] opcode that caused TRAP
+    pc: jnp.ndarray  # i32[L]
+    code_id: jnp.ndarray  # i32[L] row into CodeBank
+    stack: jnp.ndarray  # u32[L, S, 16]
+    sp: jnp.ndarray  # i32[L] number of live stack slots
+    memory: jnp.ndarray  # u8[L, M]
+    mem_words: jnp.ndarray  # i32[L] EVM msize / 32 (expansion high-water)
+    gas_left: jnp.ndarray  # u32[L]
+    storage_key: jnp.ndarray  # u32[L, K, 16]
+    storage_val: jnp.ndarray  # u32[L, K, 16]
+    storage_used: jnp.ndarray  # bool[L, K]
+    ret_off: jnp.ndarray  # i32[L] RETURN/REVERT data offset
+    ret_len: jnp.ndarray  # i32[L]
+    calldata: jnp.ndarray  # u8[L, C]
+    calldata_len: jnp.ndarray  # i32[L]
+    callvalue: jnp.ndarray  # u32[L, 16]
+    caller: jnp.ndarray  # u32[L, 16]
+    origin: jnp.ndarray  # u32[L, 16]
+    address: jnp.ndarray  # u32[L, 16]
+    balance: jnp.ndarray  # u32[L, 16] self-balance
+    steps: jnp.ndarray  # i32[L] instructions retired in this lane
+
+
+def empty_batch(cfg: BatchConfig) -> StateBatch:
+    L, S, M, C, K = (
+        cfg.lanes,
+        cfg.stack_slots,
+        cfg.memory_bytes,
+        cfg.calldata_bytes,
+        cfg.storage_slots,
+    )
+    word0 = jnp.zeros((L, words.NDIGITS), dtype=U32)
+    return StateBatch(
+        alive=jnp.zeros((L,), dtype=jnp.bool_),
+        status=jnp.zeros((L,), dtype=I32),
+        trap_op=jnp.zeros((L,), dtype=I32),
+        pc=jnp.zeros((L,), dtype=I32),
+        code_id=jnp.zeros((L,), dtype=I32),
+        stack=jnp.zeros((L, S, words.NDIGITS), dtype=U32),
+        sp=jnp.zeros((L,), dtype=I32),
+        memory=jnp.zeros((L, M), dtype=jnp.uint8),
+        mem_words=jnp.zeros((L,), dtype=I32),
+        gas_left=jnp.zeros((L,), dtype=U32),
+        storage_key=jnp.zeros((L, K, words.NDIGITS), dtype=U32),
+        storage_val=jnp.zeros((L, K, words.NDIGITS), dtype=U32),
+        storage_used=jnp.zeros((L, K), dtype=jnp.bool_),
+        ret_off=jnp.zeros((L,), dtype=I32),
+        ret_len=jnp.zeros((L,), dtype=I32),
+        calldata=jnp.zeros((L, C), dtype=jnp.uint8),
+        calldata_len=jnp.zeros((L,), dtype=I32),
+        callvalue=word0,
+        caller=word0,
+        origin=word0,
+        address=word0,
+        balance=word0,
+        steps=jnp.zeros((L,), dtype=I32),
+    )
+
+
+def make_code_bank(codes, code_len: int) -> CodeBank:
+    """Host helper: list of bytes objects -> CodeBank (pads / analyses)."""
+    n = len(codes)
+    code = np.zeros((n, code_len), dtype=np.uint8)
+    lens = np.zeros((n,), dtype=np.int32)
+    jd = np.zeros((n, code_len), dtype=bool)
+    for i, c in enumerate(codes):
+        if len(c) > code_len:
+            raise ValueError(f"code {i} length {len(c)} exceeds bank width {code_len}")
+        code[i, : len(c)] = np.frombuffer(bytes(c), dtype=np.uint8)
+        lens[i] = len(c)
+        # Mark JUMPDESTs that are real instruction starts (not push data).
+        pc = 0
+        while pc < len(c):
+            op = c[pc]
+            if op == 0x5B:
+                jd[i, pc] = True
+            if 0x60 <= op <= 0x7F:
+                pc += op - 0x5F
+            pc += 1
+    return CodeBank(jnp.asarray(code), jnp.asarray(lens), jnp.asarray(jd))
+
+
+def default_env() -> Env:
+    w = lambda x: jnp.asarray(words.from_int(x))
+    return Env(
+        number=w(17_000_000),
+        timestamp=w(1_700_000_000),
+        coinbase=w(0xC0FFEE),
+        difficulty=w(0x0200000),
+        gaslimit=w(30_000_000),
+        chainid=w(1),
+        basefee=w(10**9),
+        gasprice=w(10**9),
+        blockhash=w(0xB10C4A54),
+    )
+
+
+def load_lane(
+    st: StateBatch,
+    lane: int,
+    *,
+    code_id: int = 0,
+    calldata: bytes = b"",
+    callvalue: int = 0,
+    caller: int = 0xDEADBEEF,
+    origin: Optional[int] = None,
+    address: int = 0xAFFE,
+    balance: int = 10**18,
+    gas: int = 10_000_000,
+    storage: Optional[dict] = None,
+) -> StateBatch:
+    """Host helper: place one fresh message-call state into a lane."""
+    np_batch = {k: np.array(v) for k, v in st._asdict().items()}
+    C = np_batch["calldata"].shape[1]
+    if len(calldata) > C:
+        raise ValueError("calldata exceeds batch capacity")
+    np_batch["alive"][lane] = True
+    np_batch["status"][lane] = RUNNING
+    np_batch["pc"][lane] = 0
+    np_batch["code_id"][lane] = code_id
+    np_batch["sp"][lane] = 0
+    np_batch["memory"][lane] = 0
+    np_batch["mem_words"][lane] = 0
+    np_batch["gas_left"][lane] = gas
+    np_batch["storage_used"][lane] = False
+    np_batch["calldata"][lane] = 0
+    np_batch["calldata"][lane, : len(calldata)] = np.frombuffer(bytes(calldata), np.uint8)
+    np_batch["calldata_len"][lane] = len(calldata)
+    np_batch["callvalue"][lane] = words.from_int(callvalue)
+    np_batch["caller"][lane] = words.from_int(caller)
+    np_batch["origin"][lane] = words.from_int(caller if origin is None else origin)
+    np_batch["address"][lane] = words.from_int(address)
+    np_batch["balance"][lane] = words.from_int(balance)
+    np_batch["steps"][lane] = 0
+    if storage:
+        for j, (k, v) in enumerate(sorted(storage.items())):
+            np_batch["storage_key"][lane, j] = words.from_int(k)
+            np_batch["storage_val"][lane, j] = words.from_int(v)
+            np_batch["storage_used"][lane, j] = True
+    return StateBatch(**{k: jnp.asarray(v) for k, v in np_batch.items()})
+
+
+def read_memory(st: StateBatch, lane: int, off: int, length: int) -> bytes:
+    return bytes(np.asarray(st.memory)[lane, off : off + length])
+
+
+def read_storage_dict(st: StateBatch, lane: int) -> dict:
+    used = np.asarray(st.storage_used)[lane]
+    keys = np.asarray(st.storage_key)[lane]
+    vals = np.asarray(st.storage_val)[lane]
+    return {
+        words.to_int(keys[j]): words.to_int(vals[j])
+        for j in range(used.shape[0])
+        if used[j]
+    }

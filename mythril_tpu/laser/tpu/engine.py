@@ -1,0 +1,616 @@
+"""The batched EVM step kernel: one fused XLA computation per instruction.
+
+The reference interprets one ``GlobalState`` at a time through method
+dispatch (mythril/laser/ethereum/instructions.py:211 ``Instruction.evaluate``
++ a per-instruction deepcopy). Here the whole lane population advances in
+lockstep: one ``step()`` fetches each lane's opcode, evaluates *every*
+opcode family's semantics as masked vector ops over the SoA batch
+(laser/tpu/batch.py), and selects per lane. Divergence costs select-mask
+work on the VPU instead of Python dispatch per state, which is exactly the
+trade the TPU wants; the expensive families (long division, EXP,
+keccak) are gated behind ``lax.cond`` on batch-level "any lane needs it"
+predicates so their fori_loops only run when used.
+
+Semantics parity targets the reference interpreter
+(mythril/laser/ethereum/instructions.py) in concrete mode: DIV/0 = 0,
+stack limit 1024, quadratic memory gas
+(mythril/laser/ethereum/state/machine_state.py:136), Istanbul-ish static
+gas schedule (support/opcodes.py). Anything outside the device model —
+CALL family, CREATE, cross-account reads, oversized keccak, associative
+storage overflow — TRAPs the lane with its state intact so the host
+engine (laser/evm/) resumes it symbolically.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mythril_tpu.laser.tpu import words
+from mythril_tpu.laser.tpu.batch import (
+    ERROR,
+    REVERTED,
+    RETURNED,
+    RUNNING,
+    STOPPED,
+    TRAP,
+    CodeBank,
+    Env,
+    StateBatch,
+)
+from mythril_tpu.laser.tpu.keccak_tpu import keccak256_batch
+from mythril_tpu.support.opcodes import OPCODES
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+EVM_STACK_LIMIT = 1024
+SHA_CAP = 544  # 4 keccak blocks; longer inputs trap to the host
+
+# ---------------------------------------------------------------------------
+# opcode metadata planes (host constants baked into the jitted kernel)
+
+_POPS = np.zeros(256, dtype=np.int32)
+_PUSHES = np.zeros(256, dtype=np.int32)
+_GAS = np.zeros(256, dtype=np.uint32)
+_KNOWN = np.zeros(256, dtype=bool)
+for _b, _spec in OPCODES.items():
+    _KNOWN[_b] = True
+    _POPS[_b] = _spec.pops
+    _PUSHES[_b] = _spec.pushes
+    _GAS[_b] = _spec.min_gas
+_GAS[0x55] = 0  # SSTORE gas is fully dynamic (computed in step)
+
+# Ops the device kernel does not model: lane traps, host resumes.
+_TRAP_OPS = [
+    0x31,  # BALANCE (non-self; self handled on device)
+    0x3B, 0x3C, 0x3F,  # EXTCODESIZE/EXTCODECOPY/EXTCODEHASH
+    0xF0, 0xF1, 0xF2, 0xF4, 0xF5, 0xFA,  # CREATE/CALL family/CREATE2
+    0xFF,  # SELFDESTRUCT
+]
+_TRAP_TABLE = np.zeros(256, dtype=bool)
+for _b in _TRAP_OPS:
+    _TRAP_TABLE[_b] = True
+
+_INVALID = ~_KNOWN.copy()
+_INVALID[0xFE] = True  # INVALID / ASSERT_FAIL
+
+
+def _sel(res, mask, val):
+    return jnp.where(mask[:, None], val, res)
+
+
+def _ceil_div32(x):
+    return (x + 31) // 32
+
+
+def _mem_gas(old_words, new_words):
+    """EVM quadratic memory gas delta (machine_state.py:136 equivalent)."""
+    c_new = 3 * new_words + (new_words * new_words) // 512
+    c_old = 3 * old_words + (old_words * old_words) // 512
+    return (c_new - c_old).astype(U32)
+
+
+@partial(jax.jit, static_argnames=())
+def step(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
+    L, S, _ = st.stack.shape
+    M = st.memory.shape[1]
+    C = st.calldata.shape[1]
+    K = st.storage_key.shape[1]
+    CL = cb.code.shape[1]
+    lane = jnp.arange(L)
+
+    running = st.alive & (st.status == RUNNING)
+
+    my_code_len = cb.code_len[st.code_id]
+    pc_safe = jnp.clip(st.pc, 0, CL - 1)
+    raw_op = cb.code[st.code_id, pc_safe].astype(I32)
+    past_end = st.pc >= my_code_len
+    op = jnp.where(past_end, 0x00, raw_op)  # run off code end == STOP
+
+    pops = jnp.asarray(_POPS)[op]
+    pushes = jnp.asarray(_PUSHES)[op]
+    static_gas = jnp.asarray(_GAS)[op]
+    is_invalid = jnp.asarray(_INVALID)[op]
+    is_trap_op = jnp.asarray(_TRAP_TABLE)[op]
+
+    def peek(k):
+        idx = jnp.clip(st.sp - 1 - k, 0, S - 1)
+        return st.stack[lane, idx]
+
+    a, b, c = peek(0), peek(1), peek(2)
+
+    # ------------------------------------------------------------------
+    # stack discipline
+    underflow = st.sp < pops
+    new_sp = st.sp - pops + pushes
+    model_overflow = new_sp > S  # batch capacity: trap, host takes over
+    evm_overflow = new_sp > EVM_STACK_LIMIT
+
+    # ------------------------------------------------------------------
+    # offsets: i32 views of the top operands for memory/jump addressing.
+    # Values >= 2^31 would go negative in i32 and slip past range checks,
+    # so "fits" means fits-in-i31; non-fitting operands are clamped to a
+    # large positive sentinel (safely past every capacity bound, and still
+    # small enough that sentinel + sentinel cannot wrap i32).
+    _SENT = I32(1 << 28)
+
+    def off_view(w):
+        u = words.to_u32(w)
+        ok = words.fits_u32(w) & (u < (1 << 28))
+        return jnp.where(ok, u.astype(I32), _SENT), ok
+
+    a32, a_fits = off_view(a)
+    b32, b_fits = off_view(b)
+    c32, c_fits = off_view(c)
+
+    def opmask(*bytes_):
+        m = jnp.zeros((L,), dtype=jnp.bool_)
+        for x in bytes_:
+            m = m | (op == x)
+        return m
+
+    # ------------------------------------------------------------------
+    # memory-touching ranges -> expansion words, capacity traps
+    is_mload = opmask(0x51)
+    is_mstore = opmask(0x52)
+    is_mstore8 = opmask(0x53)
+    is_sha3 = opmask(0x20)
+    is_cdcopy = opmask(0x37)
+    is_codecopy = opmask(0x39)
+    is_retcopy = opmask(0x3E)
+    is_return = opmask(0xF3)
+    is_revert = opmask(0xFD)
+    is_log = (op >= 0xA0) & (op <= 0xA4)
+
+    zero = jnp.zeros((L,), dtype=I32)
+    m_off = zero
+    m_len = zero
+    off_fits = jnp.ones((L,), dtype=jnp.bool_)
+    # (off, len) per family; MSTORE/MLOAD fixed 32, MSTORE8 1
+    for mask, off, ln, fits in (
+        (is_mload | is_mstore, a32, jnp.full((L,), 32, I32), a_fits),
+        (is_mstore8, a32, jnp.full((L,), 1, I32), a_fits),
+        (is_sha3 | is_return | is_revert | is_log, a32, b32, a_fits & b_fits),
+        (is_cdcopy | is_codecopy, a32, c32, a_fits & c_fits),
+    ):
+        m_off = jnp.where(mask, off, m_off)
+        m_len = jnp.where(mask, ln, m_len)
+        off_fits = jnp.where(mask, fits, off_fits)
+    touches = m_len > 0
+    m_end = m_off + m_len
+    mem_cap_trap = touches & ((~off_fits) | (m_end > M))
+    new_mem_words = jnp.where(
+        touches, jnp.maximum(st.mem_words, _ceil_div32(m_end)), st.mem_words
+    )
+    gas_mem = jnp.where(touches, _mem_gas(st.mem_words, new_mem_words), 0).astype(U32)
+
+    # RETURNDATACOPY with len>0 needs call returndata -> host
+    retcopy_trap = is_retcopy & (c32 > 0)
+
+    # ------------------------------------------------------------------
+    # ALU (cheap families, unconditional)
+    res = jnp.zeros((L, words.NDIGITS), dtype=U32)
+    res = _sel(res, opmask(0x01), words.add(a, b))
+    res = _sel(res, opmask(0x03), words.sub(a, b))
+    res = _sel(res, opmask(0x0B), words.signextend(a, b))
+    res = _sel(res, opmask(0x10), words.bool_to_word(words.ult(a, b)))
+    res = _sel(res, opmask(0x11), words.bool_to_word(words.ugt(a, b)))
+    res = _sel(res, opmask(0x12), words.bool_to_word(words.slt(a, b)))
+    res = _sel(res, opmask(0x13), words.bool_to_word(words.sgt(a, b)))
+    res = _sel(res, opmask(0x14), words.bool_to_word(words.eq(a, b)))
+    res = _sel(res, opmask(0x15), words.bool_to_word(words.is_zero(a)))
+    res = _sel(res, opmask(0x16), a & b)
+    res = _sel(res, opmask(0x17), a | b)
+    res = _sel(res, opmask(0x18), a ^ b)
+    res = _sel(res, opmask(0x19), words.bit_not(a))
+    res = _sel(res, opmask(0x1A), words.byte_word(a, b))
+    res = _sel(res, opmask(0x1B), words.shl(a, b))
+    res = _sel(res, opmask(0x1C), words.shr(a, b))
+    res = _sel(res, opmask(0x1D), words.sar(a, b))
+
+    # MUL is a 256-entry product sum; cheap enough to keep unconditional.
+    is_mul = opmask(0x02)
+    res = _sel(res, is_mul, words.mul(a, b))
+
+    # ------------------------------------------------------------------
+    # division family under one cond (256-bit long division)
+    div_mask = opmask(0x04, 0x05, 0x06, 0x07)
+    signed = opmask(0x05, 0x07)
+    aa, an = words._abs_signed(a)
+    bb, _bn = words._abs_signed(b)
+    dividend = jnp.where(signed[:, None], aa, a)
+    divisor = jnp.where(signed[:, None], bb, b)
+
+    def do_div(_):
+        q, r = words.divmod256(dividend, divisor)
+        return q, r
+
+    def skip_div(_):
+        z = jnp.zeros_like(a)
+        return z, z
+
+    q, r = jax.lax.cond(jnp.any(div_mask & running), do_div, skip_div, None)
+    res = _sel(res, opmask(0x04), q)
+    res = _sel(res, opmask(0x06), r)
+    res = _sel(res, opmask(0x05), _signed_fix_div(q, a, b))
+    res = _sel(res, opmask(0x07), _signed_fix_mod(r, a))
+
+    # ADDMOD / MULMOD under one 512-bit cond
+    modal = opmask(0x08, 0x09)
+
+    def do_modal(_):
+        s, carry = words.add_carry(a, b)
+        wide_add = jnp.concatenate(
+            [s, carry[:, None], jnp.zeros((L, words.NDIGITS - 1), U32)], axis=-1
+        )
+        wide_mul = words.mul_full(a, b)
+        wide = jnp.where(opmask(0x09)[:, None], wide_mul, wide_add)
+        _q, rr = words._divmod_wide(wide, c, 512)
+        return jnp.where(words.is_zero(c)[:, None], 0, rr)
+
+    res = _sel(
+        res,
+        modal,
+        jax.lax.cond(
+            jnp.any(modal & running), do_modal, lambda _: jnp.zeros_like(a), None
+        ),
+    )
+
+    # EXP under cond
+    is_exp = opmask(0x0A)
+    res = _sel(
+        res,
+        is_exp,
+        jax.lax.cond(
+            jnp.any(is_exp & running),
+            lambda _: words.exp(a, b),
+            lambda _: jnp.zeros_like(a),
+            None,
+        ),
+    )
+    # EXP dynamic gas: 50 per exponent byte (EIP-160)
+    exp_bytes = _byte_length(b)
+    gas_exp = jnp.where(is_exp, 50 * exp_bytes, 0).astype(U32)
+
+    # ------------------------------------------------------------------
+    # environment / block pushes
+    res = _sel(res, opmask(0x30), st.address)
+    res = _sel(res, opmask(0x32), st.origin)
+    res = _sel(res, opmask(0x33), st.caller)
+    res = _sel(res, opmask(0x34), st.callvalue)
+    res = _sel(res, opmask(0x36), words.from_u32(st.calldata_len.astype(U32)))
+    res = _sel(res, opmask(0x38), words.from_u32(my_code_len.astype(U32)))
+    res = _sel(res, opmask(0x3A), jnp.broadcast_to(env.gasprice, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x3D), words.zeros((L,)))  # RETURNDATASIZE: no call yet
+    res = _sel(res, opmask(0x40), jnp.broadcast_to(env.blockhash, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x41), jnp.broadcast_to(env.coinbase, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x42), jnp.broadcast_to(env.timestamp, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x43), jnp.broadcast_to(env.number, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x44), jnp.broadcast_to(env.difficulty, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x45), jnp.broadcast_to(env.gaslimit, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x46), jnp.broadcast_to(env.chainid, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x47), st.balance)  # SELFBALANCE
+    res = _sel(res, opmask(0x48), jnp.broadcast_to(env.basefee, (L, words.NDIGITS)))
+    res = _sel(res, opmask(0x58), words.from_u32(st.pc.astype(U32)))
+    res = _sel(res, opmask(0x59), words.from_u32((st.mem_words * 32).astype(U32)))
+    # GAS pushes gas remaining *after* charging its own 2 gas
+    gas_after_self = jnp.where(st.gas_left >= 2, st.gas_left - 2, U32(0))
+    res = _sel(res, opmask(0x5A), words.from_u32(gas_after_self))
+
+    # BALANCE: on-device only for self-address
+    is_balance = opmask(0x31)
+    self_balance_hit = is_balance & words.eq(a, st.address)
+    res = _sel(res, self_balance_hit, st.balance)
+    balance_trap = is_balance & ~self_balance_hit
+
+    # ------------------------------------------------------------------
+    # CALLDATALOAD / MLOAD (32-byte gathers)
+    g32 = jnp.arange(32, dtype=I32)
+    cd_idx = a32[:, None] + g32[None, :]
+    cd_bytes = jnp.where(
+        (cd_idx < st.calldata_len[:, None]) & a_fits[:, None],
+        st.calldata[lane[:, None], jnp.clip(cd_idx, 0, C - 1)],
+        0,
+    )
+    res = _sel(res, opmask(0x35), words.from_bytes_be(cd_bytes))
+
+    ml_idx = a32[:, None] + g32[None, :]
+    ml_bytes = jnp.where(
+        ml_idx < M, st.memory[lane[:, None], jnp.clip(ml_idx, 0, M - 1)], 0
+    )
+    res = _sel(res, is_mload, words.from_bytes_be(ml_bytes))
+
+    # ------------------------------------------------------------------
+    # PUSH1..PUSH32 immediates (+ PUSH0)
+    is_push = (op >= 0x60) & (op <= 0x7F)
+    k_push = jnp.where(is_push, op - 0x5F, 0)
+    pj = jnp.arange(32, dtype=I32)
+    src = st.pc[:, None] + 1 + pj[None, :] - (32 - k_push[:, None])
+    pvalid = (pj[None, :] >= 32 - k_push[:, None]) & (src < my_code_len[:, None]) & (
+        src >= 0
+    )
+    pbytes = jnp.where(
+        pvalid, cb.code[st.code_id[:, None], jnp.clip(src, 0, CL - 1)], 0
+    )
+    res = _sel(res, is_push, words.from_bytes_be(pbytes))
+    res = _sel(res, opmask(0x5F), words.zeros((L,)))  # PUSH0
+
+    # ------------------------------------------------------------------
+    # SLOAD / SSTORE (associative storage probe)
+    is_sload = opmask(0x54)
+    is_sstore = opmask(0x55)
+    key_match = st.storage_used & jnp.all(
+        st.storage_key == a[:, None, :], axis=-1
+    )  # [L, K]
+    found = jnp.any(key_match, axis=-1)
+    sel_slot = jnp.argmax(key_match, axis=-1)
+    loaded = jnp.where(
+        found[:, None], st.storage_val[lane, sel_slot], jnp.zeros_like(a)
+    )
+    res = _sel(res, is_sload, loaded)
+
+    all_used = jnp.all(st.storage_used, axis=-1)
+    first_free = jnp.argmin(st.storage_used, axis=-1)
+    store_slot = jnp.where(found, sel_slot, first_free)
+    storage_trap = is_sstore & ~found & all_used
+    do_store = is_sstore & ~storage_trap & running
+    new_storage_key = st.storage_key.at[lane, store_slot].set(
+        jnp.where(do_store[:, None], a, st.storage_key[lane, store_slot])
+    )
+    new_storage_val = st.storage_val.at[lane, store_slot].set(
+        jnp.where(do_store[:, None], b, st.storage_val[lane, store_slot])
+    )
+    new_storage_used = st.storage_used.at[lane, store_slot].set(
+        st.storage_used[lane, store_slot] | do_store
+    )
+    # SSTORE gas: 20000 fresh nonzero, 5000 otherwise (no refund model)
+    sstore_gas = jnp.where(
+        is_sstore,
+        jnp.where(words.is_zero(loaded) & ~words.is_zero(b), U32(20000), U32(5000)),
+        U32(0),
+    )
+
+    # ------------------------------------------------------------------
+    # SHA3 (memory slice -> keccak, under cond)
+    sha_trap = is_sha3 & (b32 > SHA_CAP)
+
+    def do_sha(_):
+        sj = jnp.arange(SHA_CAP, dtype=I32)
+        sidx = a32[:, None] + sj[None, :]
+        sbytes = jnp.where(
+            (sj[None, :] < b32[:, None]) & (sidx < M),
+            st.memory[lane[:, None], jnp.clip(sidx, 0, M - 1)],
+            0,
+        )
+        digest = keccak256_batch(sbytes, jnp.minimum(b32, SHA_CAP))
+        return words.from_bytes_be(digest)
+
+    res = _sel(
+        res,
+        is_sha3,
+        jax.lax.cond(
+            jnp.any(is_sha3 & running & ~sha_trap),
+            do_sha,
+            lambda _: jnp.zeros_like(a),
+            None,
+        ),
+    )
+    gas_sha = jnp.where(is_sha3, 6 * _ceil_div32(b32).astype(U32), 0).astype(U32)
+    gas_copy = jnp.where(
+        is_cdcopy | is_codecopy | is_retcopy, 3 * _ceil_div32(c32).astype(U32), 0
+    ).astype(U32)
+    n_topics = jnp.where(is_log, op - 0xA0, 0)
+    # topic gas is already in the static table (LOGn min_gas = 375*(n+1));
+    # only the per-byte data gas is dynamic
+    gas_log = jnp.where(is_log, 8 * m_len.astype(U32), 0)
+
+    # ------------------------------------------------------------------
+    # DUP / SWAP
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    k_dup = op - 0x7F  # DUPk copies stack[sp-k]
+    dup_val = st.stack[lane, jnp.clip(st.sp - k_dup, 0, S - 1)]
+    res = _sel(res, is_dup, dup_val)
+
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    k_swap = op - 0x8F  # SWAPk swaps top with stack[sp-1-k]
+    swap_lo_idx = jnp.clip(st.sp - 1 - k_swap, 0, S - 1)
+    swap_hi_idx = jnp.clip(st.sp - 1, 0, S - 1)
+
+    # ------------------------------------------------------------------
+    # control flow
+    is_jump = opmask(0x56)
+    is_jumpi = opmask(0x57)
+    dest32 = a32
+    dest_ok = (
+        a_fits
+        & (dest32 < my_code_len)
+        & cb.jumpdest[st.code_id, jnp.clip(dest32, 0, CL - 1)]
+    )
+    taken = is_jump | (is_jumpi & ~words.is_zero(b))
+    jump_err = (is_jump | (is_jumpi & ~words.is_zero(b))) & ~dest_ok
+
+    pc_next = st.pc + 1 + jnp.where(is_push, k_push, 0)
+    new_pc = jnp.where(taken & dest_ok, dest32, pc_next)
+
+    # ------------------------------------------------------------------
+    # halts
+    is_stop = opmask(0x00) | past_end
+    new_ret_off = jnp.where((is_return | is_revert) & running, a32, st.ret_off)
+    new_ret_len = jnp.where((is_return | is_revert) & running, b32, st.ret_len)
+
+    # ------------------------------------------------------------------
+    # status resolution (order matters)
+    trap = (
+        is_trap_op
+        | balance_trap
+        | mem_cap_trap
+        | retcopy_trap
+        | storage_trap
+        | sha_trap
+        | (model_overflow & ~evm_overflow)
+    ) & ~is_invalid & ~underflow
+    hard_err = is_invalid | underflow | evm_overflow | jump_err
+
+    total_gas = static_gas + gas_mem + gas_exp + gas_sha + gas_copy + gas_log + sstore_gas
+    charged = ~trap & ~hard_err
+    oog = charged & (st.gas_left < total_gas)
+    new_gas = jnp.where(
+        charged & ~oog, st.gas_left - total_gas, jnp.where(oog, U32(0), st.gas_left)
+    )
+
+    new_status = jnp.where(
+        hard_err | oog,
+        ERROR,
+        jnp.where(
+            trap,
+            TRAP,
+            jnp.where(
+                is_stop,
+                STOPPED,
+                jnp.where(
+                    is_return, RETURNED, jnp.where(is_revert, REVERTED, RUNNING)
+                ),
+            ),
+        ),
+    )
+    committed = running & ~trap & ~hard_err & ~oog
+
+    # ------------------------------------------------------------------
+    # stack writes: every producing op leaves exactly one new value at the
+    # (post-pop) top; SWAP rearranges in place instead.
+    produces = (pushes > 0) & ~is_swap
+    write_idx = jnp.clip(new_sp - 1, 0, S - 1)
+    stack_after = st.stack.at[lane, write_idx].set(
+        jnp.where(
+            (committed & produces & ~is_swap)[:, None],
+            res,
+            st.stack[lane, write_idx],
+        )
+    )
+    # SWAP: two positional writes
+    swap_mask = committed & is_swap
+    lo_val = st.stack[lane, swap_lo_idx]
+    hi_val = st.stack[lane, swap_hi_idx]
+    stack_after = stack_after.at[lane, swap_lo_idx].set(
+        jnp.where(swap_mask[:, None], hi_val, stack_after[lane, swap_lo_idx])
+    )
+    stack_after = stack_after.at[lane, swap_hi_idx].set(
+        jnp.where(swap_mask[:, None], lo_val, stack_after[lane, swap_hi_idx])
+    )
+
+    # ------------------------------------------------------------------
+    # memory writes (disjoint masks, one combined commit)
+    midx = jnp.arange(M, dtype=I32)[None, :]  # [1, M]
+    mem = st.memory
+    # MSTORE
+    wmask = committed & is_mstore
+    in_rng = (midx >= m_off[:, None]) & (midx < m_end[:, None])
+    b_bytes = words.to_bytes_be(b).astype(jnp.uint8)  # [L, 32]
+    gather = jnp.take_along_axis(
+        b_bytes, jnp.clip(midx - m_off[:, None], 0, 31), axis=-1
+    )
+    mem = jnp.where(wmask[:, None] & in_rng, gather, mem)
+    # MSTORE8
+    w8 = committed & is_mstore8
+    low_byte = (b[:, 0] & 0xFF).astype(jnp.uint8)
+    mem = jnp.where(
+        w8[:, None] & (midx == m_off[:, None]), low_byte[:, None], mem
+    )
+    # CALLDATACOPY: dest=a32 off=b32 len=c32
+    wcd = committed & is_cdcopy
+    dst_rng = (midx >= a32[:, None]) & (midx < (a32 + c32)[:, None])
+    src_idx = midx - a32[:, None] + b32[:, None]
+    src_ok = (src_idx < st.calldata_len[:, None]) & b_fits[:, None] & (src_idx >= 0)
+    cd_gather = jnp.where(
+        src_ok, st.calldata[lane[:, None], jnp.clip(src_idx, 0, C - 1)], 0
+    )
+    mem = jnp.where(wcd[:, None] & dst_rng, cd_gather, mem)
+    # CODECOPY
+    wcc = committed & is_codecopy
+    csrc_idx = midx - a32[:, None] + b32[:, None]
+    csrc_ok = (csrc_idx < my_code_len[:, None]) & b_fits[:, None] & (csrc_idx >= 0)
+    cc_gather = jnp.where(
+        csrc_ok, cb.code[st.code_id[:, None], jnp.clip(csrc_idx, 0, CL - 1)], 0
+    )
+    mem = jnp.where(wcc[:, None] & dst_rng, cc_gather, mem)
+
+    # ------------------------------------------------------------------
+    # commit
+    def merge(new, old, mask=committed):
+        extra = new.ndim - mask.ndim
+        m = mask.reshape(mask.shape + (1,) * extra)
+        return jnp.where(m, new, old)
+
+    status_mask = running  # status/trap bookkeeping applies to all running lanes
+    return StateBatch(
+        alive=st.alive,
+        status=merge(new_status, st.status, status_mask),
+        trap_op=merge(jnp.where(trap, op, st.trap_op), st.trap_op, status_mask),
+        pc=merge(new_pc, st.pc),
+        code_id=st.code_id,
+        stack=merge(stack_after, st.stack),
+        sp=merge(new_sp, st.sp),
+        memory=merge(mem, st.memory),
+        mem_words=merge(new_mem_words, st.mem_words),
+        gas_left=merge(new_gas, st.gas_left, status_mask),
+        storage_key=merge(new_storage_key, st.storage_key),
+        storage_val=merge(new_storage_val, st.storage_val),
+        storage_used=merge(new_storage_used, st.storage_used),
+        ret_off=merge(new_ret_off, st.ret_off, status_mask),
+        ret_len=merge(new_ret_len, st.ret_len, status_mask),
+        calldata=st.calldata,
+        calldata_len=st.calldata_len,
+        callvalue=st.callvalue,
+        caller=st.caller,
+        origin=st.origin,
+        address=st.address,
+        balance=st.balance,
+        steps=merge(st.steps + 1, st.steps),
+    )
+
+
+def _signed_fix_div(q_unsigned, a, b):
+    """Apply SDIV sign to the unsigned quotient computed from |a|/|b|."""
+    an = words.sign_bit(a) == 1
+    bn = words.sign_bit(b) == 1
+    flip = an ^ bn
+    neg = words.sub(words.zeros(q_unsigned.shape[:-1]), q_unsigned)
+    return jnp.where(flip[:, None], neg, q_unsigned)
+
+
+def _signed_fix_mod(r_unsigned, a):
+    """SMOD takes the dividend's sign."""
+    an = words.sign_bit(a) == 1
+    neg = words.sub(words.zeros(r_unsigned.shape[:-1]), r_unsigned)
+    return jnp.where(an[:, None], neg, r_unsigned)
+
+
+def _byte_length(w):
+    """Byte length of a word's value (for EXP gas)."""
+    nz = w != 0  # [L, 16]
+    any_nz = jnp.any(nz, axis=-1)
+    h = (words.NDIGITS - 1) - jnp.argmax(nz[..., ::-1], axis=-1).astype(I32)
+    digit = jnp.take_along_axis(w, jnp.clip(h, 0, 15)[:, None].astype(I32), axis=-1)[
+        :, 0
+    ]
+    dbytes = jnp.where(digit > 0xFF, 2, 1)
+    return jnp.where(any_nz, 2 * h + dbytes, 0).astype(U32)
+
+
+@partial(jax.jit, static_argnames=("max_steps",), donate_argnames=("st",))
+def run(cb: CodeBank, env: Env, st: StateBatch, max_steps: int = 4096):
+    """Advance the batch until every lane halts/traps or max_steps."""
+
+    def cond(carry):
+        t, s = carry
+        return (t < max_steps) & jnp.any(s.alive & (s.status == RUNNING))
+
+    def body(carry):
+        t, s = carry
+        return t + 1, step(cb, env, s)
+
+    t, out = jax.lax.while_loop(cond, body, (jnp.asarray(0, I32), st))
+    return out

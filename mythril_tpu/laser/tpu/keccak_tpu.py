@@ -1,0 +1,171 @@
+"""Batched Keccak-256 for TPU: keccak-f[1600] on u32 lane pairs.
+
+The reference hashes on the host, one input at a time (_pysha3 via
+mythril/support/support_utils.py:4 and
+mythril/laser/ethereum/keccak_function_manager.py:41-49). The batched
+interpreter needs thousands of keccaks per SHA3 step, so this module
+implements the permutation directly in jnp: each 64-bit Keccak lane is a
+(lo, hi) pair of u32s, so everything stays in fast 32-bit VPU lanes (no
+x64 requirement), and the whole state is ``u32[..., 25, 2]`` vmapped over
+arbitrary leading batch axes.
+
+The 24 rounds run under ``lax.fori_loop`` with tensorized
+theta/rho/pi/chi (round constants gathered per iteration), keeping the
+compiled HLO small — a fully unrolled version takes minutes to compile
+and would bloat every kernel that embeds a hash (engine.py's SHA3 path).
+
+Inputs are fixed-capacity byte buffers ``u8[..., N]`` with an explicit
+per-row length, matching the SoA memory layout of engine.py. Padding
+(keccak multi-rate 0x01 .. 0x80) is applied on device so the kernel is a
+single fused XLA computation.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RATE = 136  # keccak-256 rate in bytes
+RATE_LANES = RATE // 8  # 17
+U32 = jnp.uint32
+
+# Rotation offsets (rho), flat index x + 5y.
+_RHO = np.array(
+    [0, 1, 62, 28, 27, 36, 44, 6, 55, 20, 3, 10, 43, 25, 39, 41, 45, 15, 21, 8, 18, 2, 61, 56, 14],
+    dtype=np.int32,
+)
+
+# pi: new[dst] = old[src] with dst = y + 5*((2x+3y)%5) for src = x + 5y.
+_PI_DST = np.zeros(25, dtype=np.int32)
+for _x in range(5):
+    for _y in range(5):
+        _PI_DST[_x + 5 * _y] = _y + 5 * ((2 * _x + 3 * _y) % 5)
+_PI_SRC_FOR_DST = np.argsort(_PI_DST).astype(np.int32)  # new[d] = old[this[d]]
+
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+_RC_LO = np.array([v & 0xFFFFFFFF for v in _RC], dtype=np.uint32)
+_RC_HI = np.array([v >> 32 for v in _RC], dtype=np.uint32)
+
+# theta D: D[x] = C[x-1] ^ rotl1(C[x+1]) — gathers along the x axis
+_X_MINUS_1 = np.array([(x - 1) % 5 for x in range(5)], dtype=np.int32)
+_X_PLUS_1 = np.array([(x + 1) % 5 for x in range(5)], dtype=np.int32)
+
+
+def _rotl64_vec(lo, hi, n):
+    """Rotate (lo, hi) u32 pairs left by per-element amounts n (0..63)."""
+    n = jnp.asarray(n, dtype=U32)
+    swap = n >= 32
+    l0 = jnp.where(swap, hi, lo)
+    h0 = jnp.where(swap, lo, hi)
+    m = jnp.where(swap, n - 32, n)
+    # m in 0..31; (x >> 32) is undefined, so guard the m == 0 case
+    new_lo = jnp.where(m == 0, l0, ((l0 << m) | (h0 >> (32 - m))) & U32(0xFFFFFFFF))
+    new_hi = jnp.where(m == 0, h0, ((h0 << m) | (l0 >> (32 - m))) & U32(0xFFFFFFFF))
+    return new_lo, new_hi
+
+
+def keccak_f(state):
+    """keccak-f[1600] on state u32[..., 25, 2] ([..., lane, (lo, hi)])."""
+    rho = jnp.asarray(_RHO)
+    pi_src = jnp.asarray(_PI_SRC_FOR_DST)
+    rc_lo = jnp.asarray(_RC_LO)
+    rc_hi = jnp.asarray(_RC_HI)
+
+    def round_body(rnd, s):
+        lo = s[..., 0]  # [..., 25]
+        hi = s[..., 1]
+        # theta
+        g = lo.reshape(lo.shape[:-1] + (5, 5))  # [..., y, x]
+        gh = hi.reshape(hi.shape[:-1] + (5, 5))
+        c_lo = g[..., 0, :] ^ g[..., 1, :] ^ g[..., 2, :] ^ g[..., 3, :] ^ g[..., 4, :]
+        c_hi = gh[..., 0, :] ^ gh[..., 1, :] ^ gh[..., 2, :] ^ gh[..., 3, :] ^ gh[..., 4, :]
+        r_lo, r_hi = _rotl64_vec(c_lo[..., _X_PLUS_1], c_hi[..., _X_PLUS_1], 1)
+        d_lo = c_lo[..., _X_MINUS_1] ^ r_lo  # [..., 5(x)]
+        d_hi = c_hi[..., _X_MINUS_1] ^ r_hi
+        lo = (g ^ d_lo[..., None, :]).reshape(lo.shape)
+        hi = (gh ^ d_hi[..., None, :]).reshape(hi.shape)
+        # rho
+        lo, hi = _rotl64_vec(lo, hi, rho)
+        # pi
+        lo = lo[..., pi_src]
+        hi = hi[..., pi_src]
+        # chi: rows of 5 along x
+        bl = lo.reshape(lo.shape[:-1] + (5, 5))  # [..., y, x]
+        bh = hi.reshape(hi.shape[:-1] + (5, 5))
+        bl1 = jnp.roll(bl, -1, axis=-1)
+        bl2 = jnp.roll(bl, -2, axis=-1)
+        bh1 = jnp.roll(bh, -1, axis=-1)
+        bh2 = jnp.roll(bh, -2, axis=-1)
+        lo = (bl ^ (~bl1 & bl2)).reshape(lo.shape)
+        hi = (bh ^ (~bh1 & bh2)).reshape(hi.shape)
+        # iota
+        lo = lo.at[..., 0].set(lo[..., 0] ^ rc_lo[rnd])
+        hi = hi.at[..., 0].set(hi[..., 0] ^ rc_hi[rnd])
+        return jnp.stack([lo, hi], axis=-1)
+
+    return jax.lax.fori_loop(0, 24, round_body, state)
+
+
+def _bytes_to_lanes(block):
+    """u8[..., 136] -> (u32[..., 17] lo, u32[..., 17] hi), little-endian lanes."""
+    b = block.astype(U32).reshape(block.shape[:-1] + (RATE_LANES, 8))
+    lo = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+    hi = b[..., 4] | (b[..., 5] << 8) | (b[..., 6] << 16) | (b[..., 7] << 24)
+    return lo, hi
+
+
+@partial(jax.jit, static_argnames=("max_blocks",))
+def keccak256_batch(data, length, max_blocks: int = None):
+    """Keccak-256 of data u8[..., N] with per-row byte length.
+
+    Returns digest bytes u8[..., 32]. Rows whose padded length exceeds the
+    buffer capacity are the caller's responsibility (clamp or trap); the
+    kernel absorbs ``ceil((length + 1) / RATE)`` blocks per row, up to
+    ``max_blocks`` (default: fit N).
+    """
+    n = data.shape[-1]
+    if max_blocks is None:
+        max_blocks = (n + 1 + RATE - 1) // RATE
+    cap = max_blocks * RATE
+    batch_shape = data.shape[:-1]
+    length = length.astype(jnp.int32)
+
+    # Build the padded message: copy input, 0x01 at `length`,
+    # 0x80 |= at last byte of the final block.
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    padded = jnp.pad(data, [(0, 0)] * len(batch_shape) + [(0, max(0, cap - n))])
+    msg = jnp.where(idx < length[..., None], padded.astype(U32), 0)
+    msg = msg | jnp.where(idx == length[..., None], U32(0x01), U32(0))
+    nblocks = (length + 1 + RATE - 1) // RATE  # >= 1
+    last = nblocks * RATE - 1
+    msg = msg | jnp.where(idx == last[..., None], U32(0x80), U32(0))
+    msg = msg.astype(jnp.uint8)
+
+    state = jnp.zeros(batch_shape + (25, 2), dtype=U32)
+
+    def absorb(b, state):
+        block = jax.lax.dynamic_slice_in_dim(msg, b * RATE, RATE, axis=-1)
+        lo, hi = _bytes_to_lanes(block)
+        xored = state.at[..., :RATE_LANES, 0].set(state[..., :RATE_LANES, 0] ^ lo)
+        xored = xored.at[..., :RATE_LANES, 1].set(xored[..., :RATE_LANES, 1] ^ hi)
+        new = keccak_f(xored)
+        take = (b < nblocks)[..., None, None]
+        return jnp.where(take, new, state)
+
+    state = jax.lax.fori_loop(0, max_blocks, absorb, state)
+
+    # squeeze 32 bytes = lanes 0..3, little-endian within each lane
+    lanes = state[..., :4, :]  # [..., 4, 2]
+    shifts = jnp.arange(4, dtype=U32) * 8
+    lo_b = (lanes[..., 0:1] >> shifts) & 0xFF  # [..., 4, 4]
+    hi_b = (lanes[..., 1:2] >> shifts) & 0xFF
+    out = jnp.concatenate([lo_b, hi_b], axis=-1).reshape(batch_shape + (32,))
+    return out.astype(jnp.uint8)

@@ -3,7 +3,36 @@ import os
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 # exercised without TPU hardware (the driver separately dry-runs the
 # multi-chip path via __graft_entry__.dryrun_multichip).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the environment pins JAX_PLATFORMS=axon for the
+# single-tenant TPU tunnel; running the whole suite through it serialises
+# on one chip and wedges if another process holds the tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Setting the env var is NOT sufficient: /root/.axon_site/sitecustomize.py
+# already registered the axon PJRT plugin at interpreter start, and jax
+# still dials the tunnel during backend init even when only cpu is
+# selected (observed: jax.devices() blocks minutes in tcp recv). Pull the
+# plugin out of the factory registry before the first jax use so tests
+# never touch the tunnel.
+try:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(_xb._backend_factories):
+        if _name not in ("cpu",):
+            _xb._backend_factories.pop(_name, None)
+    # sitecustomize imported jax with JAX_PLATFORMS=axon already latched
+    # into the config holder; the env assignment above came too late.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+# Persistent compile cache: the step kernel takes ~1 min to compile on CPU;
+# cache hits make repeated test runs fast.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,437 @@
+"""Batched TPU interpreter tests: handcrafted programs + semantics checks.
+
+Each program is assembled with the in-repo assembler
+(disassembler/asm.py), loaded into one or more lanes of a StateBatch, and
+run through engine.run; results are asserted against Python-int EVM
+semantics (an independent oracle from the limb-vector kernels under
+test). Parity model: the reference's concrete interpreter behavior
+(mythril/laser/ethereum/instructions.py).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu import words
+from mythril_tpu.laser.tpu.batch import (
+    ERROR,
+    REVERTED,
+    RETURNED,
+    RUNNING,
+    STOPPED,
+    TRAP,
+    BatchConfig,
+    default_env,
+    empty_batch,
+    load_lane,
+    make_code_bank,
+    read_memory,
+    read_storage_dict,
+)
+from mythril_tpu.laser.tpu.engine import run
+from mythril_tpu.support.keccak import keccak256
+
+CFG = BatchConfig(lanes=4, stack_slots=32, memory_bytes=1024, calldata_bytes=128,
+                  storage_slots=8, code_len=512)
+
+
+def run_code(src_or_bytes, calldata=b"", value=0, gas=10_000_000, lanes=1,
+             storage=None, cfg=CFG):
+    code = assemble(src_or_bytes) if isinstance(src_or_bytes, str) else src_or_bytes
+    cb = make_code_bank([code], cfg.code_len)
+    st = empty_batch(cfg)
+    for lane in range(lanes):
+        st = load_lane(st, lane, calldata=calldata, callvalue=value, gas=gas,
+                       storage=storage)
+    env = default_env()
+    out = run(cb, env, st, max_steps=2048)
+    return out
+
+
+def returndata(st, lane=0):
+    off = int(np.asarray(st.ret_off)[lane])
+    ln = int(np.asarray(st.ret_len)[lane])
+    return read_memory(st, lane, off, ln)
+
+
+def status(st, lane=0):
+    return int(np.asarray(st.status)[lane])
+
+
+def test_arith_return():
+    # ((3 + 4) * 5 - 1) = 34, returned as a 32-byte word
+    out = run_code(
+        """
+        PUSH1 0x04
+        PUSH1 0x03
+        ADD
+        PUSH1 0x05
+        MUL
+        PUSH1 0x01
+        SWAP1
+        SUB
+        PUSH1 0x00
+        MSTORE
+        PUSH1 0x20
+        PUSH1 0x00
+        RETURN
+        """
+    )
+    assert status(out) == RETURNED
+    assert int.from_bytes(returndata(out), "big") == 34
+
+
+def test_div_family_via_storage():
+    # store DIV/SDIV/MOD/SMOD/ADDMOD/MULMOD/EXP results at keys 0..6
+    neg7 = (-7) % (1 << 256)
+    neg3 = (-3) % (1 << 256)
+    src = f"""
+        PUSH1 0x03
+        PUSH1 0x07
+        DIV             ; 7 // 3 = 2
+        PUSH1 0x00
+        SSTORE
+        PUSH32 {hex(neg3)}
+        PUSH32 {hex(neg7)}
+        SDIV            ; -7 sdiv -3 = 2
+        PUSH1 0x01
+        SSTORE
+        PUSH1 0x03
+        PUSH1 0x07
+        MOD             ; 1
+        PUSH1 0x02
+        SSTORE
+        PUSH1 0x03
+        PUSH32 {hex(neg7)}
+        SMOD            ; -7 smod 3 = -1
+        PUSH1 0x03
+        SSTORE
+        PUSH1 0x05
+        PUSH1 0x04
+        PUSH1 0x03
+        ADDMOD          ; (3+4)%5 = 2
+        PUSH1 0x04
+        SSTORE
+        PUSH1 0x05
+        PUSH1 0x04
+        PUSH1 0x03
+        MULMOD          ; 12%5 = 2
+        PUSH1 0x05
+        SSTORE
+        PUSH1 0x0a
+        PUSH1 0x02
+        EXP             ; 2**10 = 1024
+        PUSH1 0x06
+        SSTORE
+        STOP
+        """
+    out = run_code(src)
+    assert status(out) == STOPPED
+    got = read_storage_dict(out, 0)
+    assert got[0] == 2
+    assert got[1] == 2
+    assert got[2] == 1
+    assert got[3] == (-1) % (1 << 256)
+    assert got[4] == 2
+    assert got[5] == 2
+    assert got[6] == 1024
+
+
+def test_backward_jump_loop():
+    # sum 1..10 in a JUMPI loop, store at key 0
+    src = """
+        PUSH1 0x00      ; acc
+        PUSH1 0x0a      ; i = 10
+    loop:
+        JUMPDEST
+        DUP1
+        ISZERO
+        PUSH2 :done
+        JUMPI
+        DUP1            ; acc i i
+        SWAP2           ; i i acc
+        ADD             ; i acc'
+        SWAP1           ; acc' i
+        PUSH1 0x01
+        SWAP1
+        SUB             ; acc' i-1
+        PUSH2 :loop
+        JUMP
+    done:
+        JUMPDEST
+        POP
+        PUSH1 0x00
+        SSTORE
+        STOP
+        """
+    out = run_code(src)
+    assert status(out) == STOPPED
+    assert read_storage_dict(out, 0)[0] == 55
+
+
+def test_calldata_and_sha3():
+    data = bytes(range(1, 33))
+    src = """
+        PUSH1 0x20      ; len
+        PUSH1 0x00      ; cd off
+        PUSH1 0x00      ; mem dest
+        CALLDATACOPY
+        PUSH1 0x20
+        PUSH1 0x00
+        SHA3
+        PUSH1 0x00
+        SSTORE
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 0x01
+        SSTORE
+        CALLDATASIZE
+        PUSH1 0x02
+        SSTORE
+        STOP
+        """
+    out = run_code(src, calldata=data)
+    assert status(out) == STOPPED
+    got = read_storage_dict(out, 0)
+    assert got[0] == int.from_bytes(keccak256(data), "big")
+    assert got[1] == int.from_bytes(data, "big")
+    assert got[2] == 32
+
+
+def test_calldataload_past_end_zero_pad():
+    out = run_code(
+        """
+        PUSH1 0x10
+        CALLDATALOAD
+        PUSH1 0x00
+        SSTORE
+        STOP
+        """,
+        calldata=b"\xff" * 17,  # one byte past offset 16, rest zero-pad
+    )
+    assert read_storage_dict(out, 0)[0] == 0xFF << 248
+
+
+def test_mstore8_byte_shifts():
+    src = """
+        PUSH1 0xab
+        PUSH1 0x05
+        MSTORE8
+        PUSH1 0x00
+        MLOAD           ; byte 5 = 0xab within first word
+        PUSH1 0x00
+        SSTORE
+        PUSH32 0x8000000000000000000000000000000000000000000000000000000000000000
+        PUSH1 0x01
+        SHR
+        PUSH1 0x01
+        SSTORE
+        PUSH1 0xf0
+        PUSH1 0x04
+        SHL
+        PUSH1 0x02
+        SSTORE
+        PUSH32 0xff00000000000000000000000000000000000000000000000000000000000000
+        PUSH1 0x1f
+        BYTE            ; byte 31 of 0xff00..00 = 0
+        PUSH1 0x03
+        SSTORE
+        PUSH32 0xff00000000000000000000000000000000000000000000000000000000000000
+        PUSH1 0x00
+        BYTE            ; byte 0 = 0xff
+        PUSH1 0x04
+        SSTORE
+        STOP
+        """
+    out = run_code(src)
+    got = read_storage_dict(out, 0)
+    assert got[0] == 0xAB << (8 * (31 - 5))
+    assert got[1] == 1 << 254
+    assert got[2] == 0xF00
+    assert got[3] == 0
+    assert got[4] == 0xFF
+
+
+def test_env_pushes():
+    out = run_code(
+        """
+        CALLER
+        PUSH1 0x00
+        SSTORE
+        CALLVALUE
+        PUSH1 0x01
+        SSTORE
+        ADDRESS
+        PUSH1 0x02
+        SSTORE
+        NUMBER
+        PUSH1 0x03
+        SSTORE
+        STOP
+        """,
+        value=123,
+    )
+    got = read_storage_dict(out, 0)
+    assert got[0] == 0xDEADBEEF
+    assert got[1] == 123
+    assert got[2] == 0xAFFE
+    assert got[3] == 17_000_000
+
+
+def test_revert_and_returndata():
+    out = run_code(
+        """
+        PUSH1 0x2a
+        PUSH1 0x00
+        MSTORE
+        PUSH1 0x20
+        PUSH1 0x00
+        REVERT
+        """
+    )
+    assert status(out) == REVERTED
+    assert int.from_bytes(returndata(out), "big") == 42
+
+
+def test_invalid_opcode_errors():
+    out = run_code(bytes([0xFE]))
+    assert status(out) == ERROR
+
+
+def test_bad_jump_errors():
+    out = run_code(
+        """
+        PUSH1 0x03
+        JUMP            ; 0x03 is not a JUMPDEST
+        STOP
+        """
+    )
+    assert status(out) == ERROR
+
+
+def test_jumpdest_inside_push_data_invalid():
+    # 0x5b inside push data must not count as a jump target
+    code = assemble("PUSH2 0x005b\nPUSH1 0x02\nJUMP\nSTOP")
+    out = run_code(code)
+    assert status(out) == ERROR
+
+
+def test_out_of_gas():
+    out = run_code("PUSH1 0x01\nPUSH1 0x02\nADD\nSTOP", gas=4)
+    assert status(out) == ERROR
+    assert int(np.asarray(out.gas_left)[0]) == 0
+
+
+def test_stack_underflow_errors():
+    out = run_code("ADD\nSTOP")
+    assert status(out) == ERROR
+
+
+def test_call_traps():
+    src = """
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x42
+        PUSH2 0xffff
+        CALL
+        STOP
+        """
+    out = run_code(src)
+    assert status(out) == TRAP
+    assert int(np.asarray(out.trap_op)[0]) == 0xF1
+    # lane state preserved at the CALL: 7 operands still on the stack
+    assert int(np.asarray(out.sp)[0]) == 7
+
+
+def test_run_off_code_end_stops():
+    out = run_code(bytes([0x60, 0x01]))  # PUSH1 1 then end of code
+    assert status(out) == STOPPED
+
+
+def test_many_lanes_divergent_calldata():
+    # same code, four lanes with different calldata -> different storage
+    cfg = CFG
+    code = assemble(
+        """
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 0x02
+        MUL
+        PUSH1 0x00
+        SSTORE
+        STOP
+        """
+    )
+    cb = make_code_bank([code], cfg.code_len)
+    st = empty_batch(cfg)
+    for lane in range(4):
+        st = load_lane(st, lane, calldata=(lane + 1).to_bytes(32, "big"))
+    out = run(cb, default_env(), st, max_steps=256)
+    for lane in range(4):
+        assert int(np.asarray(out.status)[lane]) == STOPPED
+        assert read_storage_dict(out, lane)[0] == 2 * (lane + 1)
+
+
+def test_gas_accounting_simple():
+    # PUSH1(3)*2 + ADD(3) + POP(2) + STOP(0) = 11
+    out = run_code("PUSH1 0x01\nPUSH1 0x02\nADD\nPOP\nSTOP", gas=1000)
+    assert status(out) == STOPPED
+    assert int(np.asarray(out.gas_left)[0]) == 1000 - 11
+
+
+def test_memory_expansion_gas():
+    # MSTORE at 0: 3 (static) + 3 words... expansion to 1 word = 3 + 0 (1*1/512 floor)
+    out = run_code("PUSH1 0x2a\nPUSH1 0x00\nMSTORE\nSTOP", gas=1000)
+    assert status(out) == STOPPED
+    # PUSH1+PUSH1 = 6, MSTORE static 3, expansion 3*1 + 1*1//512 = 3
+    assert int(np.asarray(out.gas_left)[0]) == 1000 - 6 - 3 - 3
+
+
+def test_huge_offset_mstore_traps():
+    # offsets >= 2^31 must not wrap negative and slip past bounds checks
+    out = run_code("PUSH1 0x2a\nPUSH4 0x80000000\nMSTORE\nSTOP")
+    assert status(out) == TRAP
+
+
+def test_huge_jump_dest_errors():
+    out = run_code("PUSH4 0x80000000\nJUMP\nSTOP")
+    assert status(out) == ERROR
+    out = run_code("PUSH32 " + hex((1 << 255) + 0) + "\nJUMP\nSTOP")
+    assert status(out) == ERROR
+
+
+def test_huge_calldataload_offset_zero():
+    out = run_code(
+        "PUSH4 0x80000000\nCALLDATALOAD\nPUSH1 0x00\nSSTORE\nSTOP",
+        calldata=b"\xff" * 32,
+    )
+    assert status(out) == STOPPED
+    assert read_storage_dict(out, 0).get(0, 0) == 0
+
+
+def test_log_gas_not_double_charged():
+    # LOG1 with empty data: 2x PUSH(3) for off/len + 1 PUSH topic + 750 static
+    out = run_code("PUSH1 0x00\nPUSH1 0x00\nPUSH1 0x00\nLOG1\nSTOP", gas=10_000)
+    assert status(out) == STOPPED
+    assert int(np.asarray(out.gas_left)[0]) == 10_000 - 9 - 750
+
+
+def test_signextend_and_compare():
+    src = """
+        PUSH1 0xff
+        PUSH1 0x00
+        SIGNEXTEND      ; 0xff -> -1
+        PUSH1 0x00
+        SLT             ; -1 < 0 ? wait: stack [v, 0]; SLT pops a=0? order
+        PUSH1 0x00
+        SSTORE
+        STOP
+        """
+    out = run_code(src)
+    # SLT pops top as a, next as b, computes a < b: a=0x00, b=-1 -> 0 < -1 false...
+    # EVM: SLT pops x then y, result x < y. Here x=0 (pushed last), y=signextend=-1.
+    assert read_storage_dict(out, 0)[0] == 0

@@ -1,0 +1,43 @@
+"""Differential tests: batched device keccak vs the host implementation.
+
+Mirrors the reference's reliance on a known-good keccak
+(mythril/support/support_utils.py:4); the device kernel must agree
+byte-for-byte on every input length across block boundaries.
+"""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mythril_tpu.laser.tpu.keccak_tpu import keccak256_batch, keccak_f
+from mythril_tpu.support.keccak import keccak256
+
+
+def test_keccak256_batch_matches_host():
+    random.seed(7)
+    cases = [b"", b"abc", b"a" * 135, b"a" * 136, b"a" * 137, b"a" * 271, b"a" * 272]
+    cases += [
+        bytes(random.randrange(256) for _ in range(random.randrange(0, 290)))
+        for _ in range(24)
+    ]
+    cap = 300
+    data = np.zeros((len(cases), cap), dtype=np.uint8)
+    lens = np.zeros(len(cases), dtype=np.int32)
+    for i, c in enumerate(cases):
+        data[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        lens[i] = len(c)
+    out = np.asarray(keccak256_batch(jnp.asarray(data), jnp.asarray(lens)))
+    for i, c in enumerate(cases):
+        assert bytes(out[i]) == keccak256(c), (i, len(c))
+
+
+def test_keccak256_batch_2d_batch_shape():
+    data = np.zeros((2, 3, 64), dtype=np.uint8)
+    data[1, 2, :4] = [1, 2, 3, 4]
+    lens = np.array([[0, 1, 4], [64, 32, 4]], dtype=np.int32)
+    out = np.asarray(keccak256_batch(jnp.asarray(data), jnp.asarray(lens)))
+    for i in range(2):
+        for j in range(3):
+            assert bytes(out[i, j]) == keccak256(bytes(data[i, j, : lens[i, j]]))
