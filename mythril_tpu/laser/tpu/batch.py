@@ -93,7 +93,8 @@ class StateBatch(NamedTuple):
     steps: jnp.ndarray  # i32[L] instructions retired in this lane
 
 
-def empty_batch(cfg: BatchConfig) -> StateBatch:
+def batch_shapes(cfg: BatchConfig) -> dict:
+    """field -> (shape, numpy dtype) for a batch of this config."""
     L, S, M, C, K = (
         cfg.lanes,
         cfg.stack_slots,
@@ -101,31 +102,41 @@ def empty_batch(cfg: BatchConfig) -> StateBatch:
         cfg.calldata_bytes,
         cfg.storage_slots,
     )
-    word0 = jnp.zeros((L, words.NDIGITS), dtype=U32)
+    D = words.NDIGITS
+    word = ((L, D), np.uint32)
+    return {
+        "alive": ((L,), np.bool_),
+        "status": ((L,), np.int32),
+        "trap_op": ((L,), np.int32),
+        "pc": ((L,), np.int32),
+        "code_id": ((L,), np.int32),
+        "stack": ((L, S, D), np.uint32),
+        "sp": ((L,), np.int32),
+        "memory": ((L, M), np.uint8),
+        "mem_words": ((L,), np.int32),
+        "gas_left": ((L,), np.uint32),
+        "storage_key": ((L, K, D), np.uint32),
+        "storage_val": ((L, K, D), np.uint32),
+        "storage_used": ((L, K), np.bool_),
+        "ret_off": ((L,), np.int32),
+        "ret_len": ((L,), np.int32),
+        "calldata": ((L, C), np.uint8),
+        "calldata_len": ((L,), np.int32),
+        "callvalue": word,
+        "caller": word,
+        "origin": word,
+        "address": word,
+        "balance": word,
+        "steps": ((L,), np.int32),
+    }
+
+
+def empty_batch(cfg: BatchConfig) -> StateBatch:
     return StateBatch(
-        alive=jnp.zeros((L,), dtype=jnp.bool_),
-        status=jnp.zeros((L,), dtype=I32),
-        trap_op=jnp.zeros((L,), dtype=I32),
-        pc=jnp.zeros((L,), dtype=I32),
-        code_id=jnp.zeros((L,), dtype=I32),
-        stack=jnp.zeros((L, S, words.NDIGITS), dtype=U32),
-        sp=jnp.zeros((L,), dtype=I32),
-        memory=jnp.zeros((L, M), dtype=jnp.uint8),
-        mem_words=jnp.zeros((L,), dtype=I32),
-        gas_left=jnp.zeros((L,), dtype=U32),
-        storage_key=jnp.zeros((L, K, words.NDIGITS), dtype=U32),
-        storage_val=jnp.zeros((L, K, words.NDIGITS), dtype=U32),
-        storage_used=jnp.zeros((L, K), dtype=jnp.bool_),
-        ret_off=jnp.zeros((L,), dtype=I32),
-        ret_len=jnp.zeros((L,), dtype=I32),
-        calldata=jnp.zeros((L, C), dtype=jnp.uint8),
-        calldata_len=jnp.zeros((L,), dtype=I32),
-        callvalue=word0,
-        caller=word0,
-        origin=word0,
-        address=word0,
-        balance=word0,
-        steps=jnp.zeros((L,), dtype=I32),
+        **{
+            k: jnp.zeros(shape, dtype=dtype)
+            for k, (shape, dtype) in batch_shapes(cfg).items()
+        }
     )
 
 
@@ -167,8 +178,8 @@ def default_env() -> Env:
     )
 
 
-def load_lane(
-    st: StateBatch,
+def _fill_lane(
+    np_batch: dict,
     lane: int,
     *,
     code_id: int = 0,
@@ -180,9 +191,7 @@ def load_lane(
     balance: int = 10**18,
     gas: int = 10_000_000,
     storage: Optional[dict] = None,
-) -> StateBatch:
-    """Host helper: place one fresh message-call state into a lane."""
-    np_batch = {k: np.array(v) for k, v in st._asdict().items()}
+) -> None:
     C = np_batch["calldata"].shape[1]
     if len(calldata) > C:
         raise ValueError("calldata exceeds batch capacity")
@@ -205,10 +214,36 @@ def load_lane(
     np_batch["balance"][lane] = words.from_int(balance)
     np_batch["steps"][lane] = 0
     if storage:
+        if len(storage) > np_batch["storage_used"].shape[1]:
+            raise ValueError("storage exceeds batch slot capacity")
         for j, (k, v) in enumerate(sorted(storage.items())):
             np_batch["storage_key"][lane, j] = words.from_int(k)
             np_batch["storage_val"][lane, j] = words.from_int(v)
             np_batch["storage_used"][lane, j] = True
+
+
+def build_batch(cfg: BatchConfig, lane_specs) -> StateBatch:
+    """Host helper: build a batch with one device transfer.
+
+    ``lane_specs`` is a list of kwarg dicts (see _fill_lane); lane i gets
+    spec i, remaining lanes stay free (dead). Much faster than repeated
+    load_lane for thousands of lanes (one host->device copy total).
+    """
+    if len(lane_specs) > cfg.lanes:
+        raise ValueError("more lane specs than lanes")
+    np_batch = {
+        k: np.zeros(shape, dtype=dtype)
+        for k, (shape, dtype) in batch_shapes(cfg).items()
+    }
+    for lane, spec in enumerate(lane_specs):
+        _fill_lane(np_batch, lane, **spec)
+    return StateBatch(**{k: jnp.asarray(v) for k, v in np_batch.items()})
+
+
+def load_lane(st: StateBatch, lane: int, **kwargs) -> StateBatch:
+    """Host helper: place one fresh message-call state into a lane."""
+    np_batch = {k: np.array(v) for k, v in st._asdict().items()}
+    _fill_lane(np_batch, lane, **kwargs)
     return StateBatch(**{k: jnp.asarray(v) for k, v in np_batch.items()})
 
 

@@ -64,8 +64,9 @@ for _b, _spec in OPCODES.items():
 _GAS[0x55] = 0  # SSTORE gas is fully dynamic (computed in step)
 
 # Ops the device kernel does not model: lane traps, host resumes.
+# (BALANCE 0x31 is absent: self-address reads answer on device, and the
+# non-self case traps via balance_trap in step.)
 _TRAP_OPS = [
-    0x31,  # BALANCE (non-self; self handled on device)
     0x3B, 0x3C, 0x3F,  # EXTCODESIZE/EXTCODECOPY/EXTCODEHASH
     0xF0, 0xF1, 0xF2, 0xF4, 0xF5, 0xFA,  # CREATE/CALL family/CREATE2
     0xFF,  # SELFDESTRUCT
@@ -93,8 +94,7 @@ def _mem_gas(old_words, new_words):
     return (c_new - c_old).astype(U32)
 
 
-@partial(jax.jit, static_argnames=())
-def step(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
+def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     L, S, _ = st.stack.shape
     M = st.memory.shape[1]
     C = st.calldata.shape[1]
@@ -402,7 +402,6 @@ def step(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     gas_copy = jnp.where(
         is_cdcopy | is_codecopy | is_retcopy, 3 * _ceil_div32(c32).astype(U32), 0
     ).astype(U32)
-    n_topics = jnp.where(is_log, op - 0xA0, 0)
     # topic gas is already in the static table (LOGn min_gas = 375*(n+1));
     # only the per-byte data gas is dynamic
     gas_log = jnp.where(is_log, 8 * m_len.astype(U32), 0)
@@ -430,7 +429,7 @@ def step(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         & cb.jumpdest[st.code_id, jnp.clip(dest32, 0, CL - 1)]
     )
     taken = is_jump | (is_jumpi & ~words.is_zero(b))
-    jump_err = (is_jump | (is_jumpi & ~words.is_zero(b))) & ~dest_ok
+    jump_err = taken & ~dest_ok
 
     pc_next = st.pc + 1 + jnp.where(is_push, k_push, 0)
     new_pc = jnp.where(taken & dest_ok, dest32, pc_next)
@@ -485,7 +484,7 @@ def step(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     write_idx = jnp.clip(new_sp - 1, 0, S - 1)
     stack_after = st.stack.at[lane, write_idx].set(
         jnp.where(
-            (committed & produces & ~is_swap)[:, None],
+            (committed & produces)[:, None],
             res,
             st.stack[lane, write_idx],
         )
@@ -570,6 +569,9 @@ def step(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         balance=st.balance,
         steps=merge(st.steps + 1, st.steps),
     )
+
+
+step = jax.jit(step_impl)
 
 
 def _signed_fix_div(q_unsigned, a, b):

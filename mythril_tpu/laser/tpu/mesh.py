@@ -1,0 +1,114 @@
+"""Multi-chip SPMD execution of the state batch over a jax.sharding.Mesh.
+
+The reference is strictly single-process (SURVEY.md §2.3: no parallel
+backend of any kind); the available parallelism is path-level — every
+GlobalState in the work list is independent. Here that becomes data
+parallelism over the lane axis: the whole ``StateBatch`` is sharded
+lane-wise across devices (``PartitionSpec('paths')`` on every leading
+axis), the step kernel runs purely lane-locally so GSPMD partitions it
+with zero communication, and the only collective is deliberate:
+``rebalance()`` globally permutes lanes so live work is spread evenly
+across shards (an all-to-all over ICI when lane occupancy diverges —
+the work-stealing analog of the reference's shared work list,
+mythril/laser/ethereum/svm.py:85).
+
+Device placement: one mesh axis ``'paths'``; multi-host meshes extend the
+same axis over DCN. Tests exercise this on a virtual 8-device CPU mesh
+(tests/conftest.py), and __graft_entry__.dryrun_multichip compiles and
+runs the full sharded round end-to-end.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mythril_tpu.laser.tpu.batch import RUNNING, CodeBank, Env, StateBatch
+from mythril_tpu.laser.tpu.engine import step
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    return Mesh(np.array(devs[:n]), ("paths",))
+
+
+def path_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("paths"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(st: StateBatch, mesh: Mesh) -> StateBatch:
+    """Place every lane-major array lane-sharded across the mesh."""
+    return jax.device_put(st, path_sharding(mesh))
+
+
+def put_replicated(tree, mesh: Mesh):
+    return jax.device_put(tree, replicated(mesh))
+
+
+def rebalance(st: StateBatch) -> StateBatch:
+    """Globally permute lanes so running work packs evenly across shards.
+
+    Sorts lanes by (not running) then by a round-robin spreading key, so
+    live lanes end up striped across devices. Under GSPMD on a sharded
+    lane axis this lowers to cross-device all-to-all — the explicit
+    work-stealing collective.
+    """
+    L = st.pc.shape[0]
+    # Stable partition (running lanes first) followed by a stride
+    # interleave that deals the packed prefix round-robin across the
+    # contiguous per-device blocks. Without the interleave the argsort
+    # alone would CONCENTRATE running lanes on shard 0 — worse than no
+    # permutation — so when no usable stride exists, skip entirely.
+    stride = min(64, L & (-L))  # largest power of two dividing L, capped
+    if stride < 2:
+        return st
+    running = st.alive & (st.status == RUNNING)
+    order = jnp.argsort(~running, stable=True)
+    deal = jnp.arange(L).reshape(stride, L // stride).T.reshape(-1)
+    order = order[deal]
+
+    def permute(x):
+        return x[order] if x.ndim >= 1 and x.shape[0] == L else x
+
+    return jax.tree_util.tree_map(permute, st)
+
+
+def round_impl(
+    cb: CodeBank,
+    env: Env,
+    st: StateBatch,
+    steps_per_round: int = 64,
+    do_rebalance: bool = True,
+) -> StateBatch:
+    """One distributed round: local lockstep stepping, then rebalance.
+
+    This is the jitted unit the driver dry-runs multi-chip: lane-local
+    compute partitions cleanly; the trailing rebalance is the collective.
+    """
+
+    def body(carry):
+        t, s = carry
+        return t + 1, step(cb, env, s)
+
+    def cond(carry):
+        t, s = carry
+        return (t < steps_per_round) & jnp.any(s.alive & (s.status == RUNNING))
+
+    _, out = jax.lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), st))
+    if do_rebalance:
+        out = rebalance(out)
+    return out
+
+
+sharded_round = jax.jit(
+    round_impl,
+    static_argnames=("steps_per_round", "do_rebalance"),
+    donate_argnames=("st",),
+)

@@ -24,8 +24,15 @@ try:
     # sitecustomize imported jax with JAX_PLATFORMS=axon already latched
     # into the config holder; the env assignment above came too late.
     jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+except Exception as _e:  # pragma: no cover - depends on jax internals
+    # If the private registry moved in a jax upgrade, tests WILL dial the
+    # TPU tunnel and may block for minutes — make the cause visible.
+    import warnings
+
+    warnings.warn(
+        f"conftest could not deregister non-CPU jax backends ({_e!r}); "
+        "tests may hang on the single-tenant TPU tunnel"
+    )
 # Persistent compile cache: the step kernel takes ~1 min to compile on CPU;
 # cache hits make repeated test runs fast.
 os.environ.setdefault(

@@ -391,6 +391,19 @@ def test_memory_expansion_gas():
     assert int(np.asarray(out.gas_left)[0]) == 1000 - 6 - 3 - 3
 
 
+def test_self_balance_on_device():
+    # BALANCE of the executing account answers on device (no trap)
+    out = run_code("ADDRESS\nBALANCE\nPUSH1 0x00\nSSTORE\nSTOP")
+    assert status(out) == STOPPED
+    assert read_storage_dict(out, 0)[0] == 10**18
+
+
+def test_foreign_balance_traps():
+    out = run_code("PUSH2 0x1234\nBALANCE\nPUSH1 0x00\nSSTORE\nSTOP")
+    assert status(out) == TRAP
+    assert int(np.asarray(out.trap_op)[0]) == 0x31
+
+
 def test_huge_offset_mstore_traps():
     # offsets >= 2^31 must not wrap negative and slip past bounds checks
     out = run_code("PUSH1 0x2a\nPUSH4 0x80000000\nMSTORE\nSTOP")
