@@ -1,0 +1,110 @@
+"""Interactive CFG visualisation (vis.js HTML).
+
+Parity: mythril/analysis/callgraph.py — `generate_graph(statespace)`
+renders the LASER CFG (nodes = basic blocks with their easm listing,
+edges = jumps with branch conditions) into a self-contained HTML page
+using a jinja2 template and the vis.js network layout; `--enable-physics`
+and the phrack color scheme are preserved.
+"""
+
+from jinja2 import Environment, BaseLoader
+
+graph_html_template = """<html>
+ <head>
+  <style type="text/css">
+   #mynetwork { background-color: {{ background }}; height: 100%; }
+   body { margin: 0; padding: 0; height: 100%; }
+  </style>
+  <script src="https://cdnjs.cloudflare.com/ajax/libs/vis/4.21.0/vis.min.js"></script>
+  <link href="https://cdnjs.cloudflare.com/ajax/libs/vis/4.21.0/vis.min.css" rel="stylesheet" type="text/css" />
+ </head>
+ <body>
+  <div id="mynetwork"></div>
+  <script>
+   var nodes = new vis.DataSet({{ nodes }});
+   var edges = new vis.DataSet({{ edges }});
+   var container = document.getElementById('mynetwork');
+   var data = { nodes: nodes, edges: edges };
+   var options = {
+     autoResize: true,
+     layout: { improvedLayout: true },
+     physics: { enabled: {{ physics }} },
+     nodes: {
+       color: '#000000', borderWidth: 1, borderWidthSelected: 2,
+       chosen: true, shape: 'box',
+       font: { align: 'left', color: '{{ font_color }}', face: 'courier new' }
+     },
+     edges: {
+       font: { color: '{{ font_color }}', face: 'courier new',
+               background: 'none', strokeWidth: 0 }
+     }
+   };
+   var network = new vis.Network(container, data, options);
+  </script>
+ </body>
+</html>"""
+
+
+def extract_nodes(statespace):
+    nodes = []
+    for key in statespace.nodes:
+        node = statespace.nodes[key]
+        code_lines = []
+        for state in node.states:
+            instruction = state.get_current_instruction()
+            code_lines.append(
+                "%d %s %s"
+                % (
+                    instruction["address"],
+                    instruction["opcode"],
+                    instruction.get("argument", ""),
+                )
+            )
+        nodes.append(
+            {
+                "id": str(node.uid),
+                "label": "%s:%s\\n%s"
+                % (node.contract_name, node.function_name, "\\n".join(code_lines)),
+                "size": 150,
+                "fullLabel": "\\n".join(code_lines),
+                "truncLabel": "%s:%s" % (node.contract_name, node.function_name),
+                "isExpanded": False,
+            }
+        )
+    return nodes
+
+
+def extract_edges(statespace):
+    edges = []
+    for edge in statespace.edges:
+        if edge.condition is None:
+            label = ""
+        else:
+            label = str(edge.condition).replace(",", ",\n")
+        edges.append(
+            {
+                "from": str(edge.node_from),
+                "to": str(edge.node_to),
+                "arrows": "to",
+                "label": label,
+                "smooth": {"type": "cubicBezier"},
+            }
+        )
+    return edges
+
+
+def generate_graph(statespace, physics: bool = False, phrackify: bool = False) -> str:
+    """Render the statespace's CFG as standalone HTML."""
+    env = Environment(loader=BaseLoader())
+    template = env.from_string(graph_html_template)
+    background = "#ffffff" if phrackify else "#232625"
+    font_color = "#000000" if phrackify else "#ffffff"
+    import json
+
+    return template.render(
+        nodes=json.dumps(extract_nodes(statespace)),
+        edges=json.dumps(extract_edges(statespace)),
+        physics="true" if physics else "false",
+        background=background,
+        font_color=font_color,
+    )

@@ -1,0 +1,90 @@
+"""Serializable statespace export for the `-j/--statespace-json` command.
+
+Parity: mythril/analysis/traceexplore.py `get_serializable_statespace` —
+nodes (with per-state machine snapshots) and edges in a JSON-friendly
+shape, using the same stable color palette per contract/function.
+"""
+
+from typing import Dict, List
+
+from mythril_tpu.smt import simplify
+
+colors = [
+    {"border": "#26996f", "background": "#2f7e5b", "highlight": {"border": "#fff", "background": "#28a16f"}},
+    {"border": "#9e42b3", "background": "#842899", "highlight": {"border": "#fff", "background": "#933da6"}},
+    {"border": "#b82323", "background": "#991d1d", "highlight": {"border": "#fff", "background": "#a61f1f"}},
+    {"border": "#4753bf", "background": "#3b46a1", "highlight": {"border": "#fff", "background": "#424db3"}},
+    {"border": "#26996f", "background": "#2f7e5b", "highlight": {"border": "#fff", "background": "#28a16f"}},
+    {"border": "#9e42b3", "background": "#842899", "highlight": {"border": "#fff", "background": "#933da6"}},
+    {"border": "#b82323", "background": "#991d1d", "highlight": {"border": "#fff", "background": "#a61f1f"}},
+    {"border": "#4753bf", "background": "#3b46a1", "highlight": {"border": "#fff", "background": "#424db3"}},
+]
+
+
+def get_serializable_statespace(statespace) -> Dict:
+    nodes: List[Dict] = []
+    edges: List[Dict] = []
+
+    color_map = {}
+    i = 0
+    for k in statespace.accounts:
+        color_map[statespace.accounts[k].contract_name] = colors[i % len(colors)]
+        i += 1
+
+    for node_key in statespace.nodes:
+        node = statespace.nodes[node_key]
+        code = node.get_cfg_dict()["code"]
+        code = code.replace("\\n", "\n")
+        code_split = code.split("\n")
+        truncated_code = (
+            code if len(code_split) < 7 else "\n".join(code_split[:6]) + "\n(click to expand +)"
+        )
+        color = color_map.get(node.get_cfg_dict()["contract_name"], colors[0])
+
+        states = []
+        for state in node.states:
+            machine_state = state.mstate
+            environment = state.environment
+            states.append(
+                {
+                    "pc": machine_state.pc,
+                    "memsize": machine_state.memory_size,
+                    "memory": str(machine_state.memory),
+                    "stack": [str(s) for s in machine_state.stack],
+                    "gas": machine_state.gas_limit,
+                    "code": environment.code.bytecode[:20] + "...",
+                }
+            )
+
+        nodes.append(
+            {
+                "id": str(node.uid),
+                "func": str(node.function_name),
+                "label": truncated_code,
+                "code": code,
+                "truncLabel": truncated_code,
+                "fullLabel": code,
+                "color": color,
+                "states": states,
+                "isExpanded": False,
+            }
+        )
+
+    for edge in statespace.edges:
+        if edge.condition is None:
+            label = ""
+        else:
+            try:
+                label = str(simplify(edge.condition))
+            except Exception:
+                label = str(edge.condition)
+        edges.append(
+            {
+                "from": str(edge.as_dict["from"]),
+                "to": str(edge.as_dict["to"]),
+                "arrows": "to",
+                "label": label,
+                "smooth": {"type": "cubicBezier"},
+            }
+        )
+    return {"edges": edges, "nodes": nodes}

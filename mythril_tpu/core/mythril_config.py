@@ -1,0 +1,149 @@
+"""User configuration: ~/.mythril_tpu/config.ini + env vars.
+
+Parity: mythril/mythril/mythril_config.py:19 — three config tiers (CLI
+args handled by interfaces/cli.py, ini file here, env vars MYTHRIL_DIR /
+INFURA_ID), plus RPC endpoint selection helpers (set_api_rpc* :176-230).
+"""
+
+import codecs
+import logging
+import os
+from configparser import ConfigParser
+from pathlib import Path
+from typing import Optional
+
+from mythril_tpu.ethereum.interface.rpc.client import EthJsonRpc
+from mythril_tpu.exceptions import CriticalError
+
+log = logging.getLogger(__name__)
+
+
+class MythrilConfig:
+    def __init__(self):
+        self.infura_id: Optional[str] = os.getenv("INFURA_ID")
+        self.mythril_dir = self._init_mythril_dir()
+        self.config_path = os.path.join(self.mythril_dir, "config.ini")
+        self.leveldb_dir: Optional[str] = None
+        self._init_config()
+        self.eth: Optional[EthJsonRpc] = None
+        self.eth_db = None
+
+    @staticmethod
+    def _init_mythril_dir() -> str:
+        """Create the data directory (env MYTHRIL_DIR overrides)."""
+        try:
+            mythril_dir = os.environ["MYTHRIL_DIR"]
+        except KeyError:
+            mythril_dir = os.path.join(os.path.expanduser("~"), ".mythril_tpu")
+        if not os.path.exists(mythril_dir):
+            log.info("Creating mythril data directory")
+            os.makedirs(mythril_dir, exist_ok=True)
+        db_path = str(Path(mythril_dir) / "signatures.db")
+        if not os.path.exists(db_path):
+            # copy the seed signature DB if bundled
+            asset_dir = Path(__file__).parent.parent / "support" / "assets"
+            bundled = asset_dir / "signatures.db"
+            if bundled.exists():
+                import shutil
+
+                shutil.copy(str(bundled), db_path)
+        return mythril_dir
+
+    def set_api_infura_id(self, id_: str) -> None:
+        self.infura_id = id_
+
+    def _init_config(self) -> None:
+        """Create or parse config.ini (leveldb dir, dynamic loading)."""
+        leveldb_default_path = self._get_default_leveldb_path()
+        if not os.path.exists(self.config_path):
+            log.info("No config file found. Creating default: %s", self.config_path)
+            open(self.config_path, "a").close()
+        config = ConfigParser(allow_no_value=True)
+        config.optionxform = str  # type: ignore
+        with codecs.open(self.config_path, "r", "utf-8") as f:
+            config.read_file(f)
+        if "defaults" not in config.sections():
+            config.add_section("defaults")
+        if not config.has_option("defaults", "leveldb_dir"):
+            config.set(
+                "defaults", "#Default chaindata locations:", ""
+            )
+            config.set("defaults", "leveldb_dir", leveldb_default_path)
+        if not config.has_option("defaults", "dynamic_loading"):
+            config.set(
+                "defaults",
+                "#infura: use infura.io (requires INFURA_ID); localhost: "
+                "use local RPC at :8545; HOST:PORT for anything else",
+                "",
+            )
+            config.set("defaults", "dynamic_loading", "infura")
+        with codecs.open(self.config_path, "w", "utf-8") as f:
+            config.write(f)
+        self.leveldb_dir = os.path.expanduser(
+            config.get("defaults", "leveldb_dir", fallback=leveldb_default_path)
+        )
+        self._dynamic_loading = config.get(
+            "defaults", "dynamic_loading", fallback="infura"
+        )
+
+    @staticmethod
+    def _get_default_leveldb_path() -> str:
+        home = os.path.expanduser("~")
+        # geth default datadirs per platform
+        for candidate in (
+            os.path.join(home, ".ethereum", "geth", "chaindata"),
+            os.path.join(home, "Library", "Ethereum", "geth", "chaindata"),
+            os.path.join(home, "AppData", "Roaming", "Ethereum", "geth", "chaindata"),
+        ):
+            if os.path.exists(candidate):
+                return candidate
+        return os.path.join(home, ".ethereum", "geth", "chaindata")
+
+    def set_api_from_config_path(self) -> None:
+        """Apply the ini's dynamic_loading choice."""
+        if self._dynamic_loading == "infura":
+            self.set_api_rpc_infura()
+        elif self._dynamic_loading == "localhost":
+            self.set_api_rpc_localhost()
+        else:
+            self.set_api_rpc(self._dynamic_loading)
+
+    def set_api_leveldb(self, leveldb_path: str):
+        from mythril_tpu.ethereum.interface.leveldb.client import EthLevelDB
+
+        self.eth_db = EthLevelDB(leveldb_path)
+        return self.eth_db
+
+    def set_api_rpc_infura(self) -> None:
+        if self.infura_id is None:
+            raise CriticalError(
+                "Infura key not provided, add it to the INFURA_ID environment variable"
+            )
+        self.eth = EthJsonRpc(
+            f"mainnet.infura.io/v3/{self.infura_id}", None, True
+        )
+        log.info("Using INFURA Main Net for RPC queries")
+
+    def set_api_rpc(self, rpc: Optional[str] = None, rpctls: bool = False) -> None:
+        if rpc == "ganache":
+            rpc = "localhost:8545"
+        if rpc and rpc.startswith("infura-"):
+            network = rpc[len("infura-"):]
+            if self.infura_id is None:
+                raise CriticalError(
+                    "Infura key not provided, add it to the INFURA_ID environment variable"
+                )
+            self.eth = EthJsonRpc(
+                f"{network}.infura.io/v3/{self.infura_id}", None, True
+            )
+            return
+        try:
+            host, port = (rpc or "localhost:8545").split(":")
+            self.eth = EthJsonRpc(host, int(port), rpctls)
+            log.info("Using RPC settings: %s", rpc)
+        except ValueError:
+            raise CriticalError("Invalid RPC argument, use 'HOST:PORT'")
+
+    def set_api_rpc_localhost(self) -> None:
+        self.eth = EthJsonRpc("localhost", 8545)
+        log.info("Using default RPC settings: http://localhost:8545")

@@ -1,0 +1,219 @@
+"""Contract loading: bytecode / address / solidity -> EVMContract objects.
+
+Parity: mythril/mythril/mythril_disassembler.py:23 — load_from_bytecode
+(:102), load_from_address (RPC), load_from_solidity, the read-storage
+slot math for mappings/arrays (get_state_variable_from_storage), and
+hash_for_function_signature.
+"""
+
+import logging
+import re
+from typing import List, Optional, Tuple
+
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.ethereum.interface.rpc.exceptions import EthJsonRpcError
+from mythril_tpu.exceptions import CriticalError
+from mythril_tpu.solidity.soliditycontract import (
+    SolidityContract,
+    get_contracts_from_file,
+)
+from mythril_tpu.support.keccak import keccak256
+from mythril_tpu.support.signatures import SignatureDB
+
+log = logging.getLogger(__name__)
+
+
+class MythrilDisassembler:
+    def __init__(
+        self,
+        eth=None,
+        solc_version: Optional[str] = None,
+        solc_settings_json: Optional[str] = None,
+        enable_online_lookup: bool = False,
+    ) -> None:
+        self.solc_binary = self._init_solc_binary(solc_version)
+        self.solc_settings_json = solc_settings_json
+        self.eth = eth
+        self.enable_online_lookup = enable_online_lookup
+        self.sigs = SignatureDB(enable_online_lookup=enable_online_lookup)
+        self.contracts: List[EVMContract] = []
+
+    @staticmethod
+    def _init_solc_binary(version: Optional[str]) -> str:
+        """Pick the solc binary (env SOLC overrides; no auto-install —
+        the reference pulls binaries from solc-bin, we require a local one)."""
+        import os
+
+        if not version:
+            return os.environ.get("SOLC", "solc")
+        if version.startswith("v"):
+            version = version[1:]
+        # honor an explicitly versioned binary if present on PATH
+        candidate = f"solc-v{version}"
+        from shutil import which
+
+        if which(candidate):
+            return candidate
+        log.info("Using system solc for requested version %s", version)
+        return os.environ.get("SOLC", "solc")
+
+    def load_from_bytecode(
+        self, code: str, bin_runtime: bool = False, address: Optional[str] = None
+    ) -> Tuple[str, EVMContract]:
+        """Load a contract from raw bytecode (runtime or creation)."""
+        if address is None:
+            address = "0x" + "0" * 38 + "06"
+        if code.startswith("0x"):
+            code = code[2:]
+        if bin_runtime:
+            self.contracts.append(
+                EVMContract(
+                    code=code,
+                    name="MAIN",
+                    enable_online_lookup=self.enable_online_lookup,
+                )
+            )
+        else:
+            self.contracts.append(
+                EVMContract(
+                    creation_code=code,
+                    name="MAIN",
+                    enable_online_lookup=self.enable_online_lookup,
+                )
+            )
+        return address, self.contracts[-1]
+
+    def load_from_address(self, address: str) -> Tuple[str, EVMContract]:
+        """Fetch code for `address` over RPC."""
+        if not re.match(r"0x[a-fA-F0-9]{40}", address):
+            raise CriticalError("Invalid contract address. Expected format is '0x...'.")
+        if self.eth is None:
+            raise CriticalError(
+                "Please check whether the Infura key is set or use a different RPC method."
+            )
+        try:
+            code = self.eth.eth_getCode(address)
+        except FileNotFoundError as e:
+            raise CriticalError(f"IPC error: {e}")
+        except ConnectionError:
+            raise CriticalError(
+                "Could not connect to RPC server. Make sure that your node is running."
+            )
+        except EthJsonRpcError as e:
+            raise CriticalError(f"RPC error: {e}")
+        if code in ("0x", "0x0", "", None):
+            raise CriticalError(
+                "Received an empty response from eth_getCode. Check the contract address and verify that you are on the correct chain."
+            )
+        self.contracts.append(
+            EVMContract(
+                code[2:] if code.startswith("0x") else code,
+                name=address,
+                enable_online_lookup=self.enable_online_lookup,
+            )
+        )
+        return address, self.contracts[-1]
+
+    def load_from_solidity(
+        self, solidity_files: List[str]
+    ) -> Tuple[str, List[SolidityContract]]:
+        """Compile .sol files (with optional :ContractName selectors)."""
+        address = "0x" + "0" * 38 + "06"
+        contracts: List[SolidityContract] = []
+        for file in solidity_files:
+            if ":" in file:
+                file, contract_name = file.rsplit(":", 1)
+            else:
+                contract_name = None
+            file = file.replace("~", str(__import__("pathlib").Path.home()))
+            try:
+                if contract_name is not None:
+                    contract = SolidityContract(
+                        input_file=file,
+                        name=contract_name,
+                        solc_settings_json=self.solc_settings_json,
+                        solc_binary=self.solc_binary,
+                    )
+                    self.contracts.append(contract)
+                    contracts.append(contract)
+                else:
+                    for contract in get_contracts_from_file(
+                        input_file=file,
+                        solc_settings_json=self.solc_settings_json,
+                        solc_binary=self.solc_binary,
+                    ):
+                        self.contracts.append(contract)
+                        contracts.append(contract)
+            except FileNotFoundError:
+                raise CriticalError(f"Input file not found: {file}")
+        return address, contracts
+
+    @staticmethod
+    def hash_for_function_signature(func: str) -> str:
+        """'transfer(address,uint256)' -> '0xa9059cbb'."""
+        return "0x%s" % keccak256(func.encode()).hex()[:8]
+
+    def get_state_variable_from_storage(
+        self, address: str, params: Optional[List[str]] = None
+    ) -> str:
+        """read-storage command: position[,length] / mapping/array math
+        (parity: mythril_disassembler.py read-storage helpers)."""
+        params = params or []
+        (position, length, mappings) = (0, 1, [])
+        try:
+            if params[0] == "mapping":
+                if len(params) < 3:
+                    raise CriticalError("Invalid number of parameters.")
+                position = int(params[1])
+                position_formatted = ("%064x" % position)
+                for i in range(2, len(params)):
+                    key = bytes(params[i], "utf8")
+                    key_formatted = key.rjust(32, b"\x00")
+                    mappings.append(
+                        int.from_bytes(
+                            keccak256(key_formatted + bytes.fromhex(position_formatted)),
+                            "big",
+                        )
+                    )
+                length = len(mappings)
+            else:
+                if len(params) >= 4:
+                    raise CriticalError("Invalid number of parameters.")
+                if len(params) >= 1:
+                    position = int(params[0])
+                if len(params) >= 2:
+                    length = int(params[1])
+                if len(params) == 3 and params[2] == "array":
+                    position_formatted = ("%064x" % position)
+                    position = int.from_bytes(
+                        keccak256(bytes.fromhex(position_formatted)), "big"
+                    )
+        except ValueError:
+            raise CriticalError(
+                "Invalid storage index. Please provide a numeric value."
+            )
+        outtxt = []
+        try:
+            if length == 1:
+                outtxt.append(
+                    "%x: %s"
+                    % (position, self.eth.eth_getStorageAt(address, position))
+                )
+            else:
+                if len(mappings) > 0:
+                    for i, m in enumerate(mappings):
+                        outtxt.append(
+                            "%x: %s" % (m, self.eth.eth_getStorageAt(address, m))
+                        )
+                else:
+                    for i in range(position, position + length):
+                        outtxt.append(
+                            "%x: %s" % (i, self.eth.eth_getStorageAt(address, i))
+                        )
+        except FileNotFoundError as e:
+            raise CriticalError("IPC error: " + str(e))
+        except ConnectionError:
+            raise CriticalError(
+                "Could not connect to RPC server. Make sure that your node is running."
+            )
+        return "\n".join(outtxt)

@@ -1,0 +1,27 @@
+"""LevelDB subcommands (parity: mythril/mythril/mythril_leveldb.py:5)."""
+
+import re
+
+from mythril_tpu.exceptions import CriticalError
+
+
+class MythrilLevelDB:
+    def __init__(self, leveldb) -> None:
+        self.leveldb_db = leveldb
+
+    def search_db(self, search: str) -> None:
+        """`leveldb-search` command: regex over stored contract code."""
+
+        def search_callback(_, address, balance):
+            print("Address: " + address[0])
+
+        try:
+            self.leveldb_db.search(search, search_callback)
+        except SyntaxError:
+            raise CriticalError("Syntax error in search expression.")
+
+    def contract_hash_to_address(self, contract_hash: str) -> None:
+        """`hash-to-address` command."""
+        if not re.match(r"0x[a-fA-F0-9]{64}", contract_hash):
+            raise CriticalError("Invalid address hash. Expected format is '0x...'.")
+        print(self.leveldb_db.contract_hash_to_address(contract_hash))

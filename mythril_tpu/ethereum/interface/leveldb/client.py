@@ -1,0 +1,143 @@
+"""Raw geth-chaindata reader: code search and hash->address lookup.
+
+Parity: mythril/ethereum/interface/leveldb/client.py — `LevelDBReader`
+(:46) walks the geth key schema (headers/bodies/receipts), `EthLevelDB`
+searches contract code and resolves code-hash -> address via the
+account index. A minimal RLP decoder is inlined (the reference leans on
+pyethereum; we avoid that dependency).
+"""
+
+import binascii
+import logging
+from typing import Callable, List, Optional, Tuple
+
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.ethereum.interface.leveldb.eth_db import EthDB
+from mythril_tpu.exceptions import AddressNotFoundError
+from mythril_tpu.support.keccak import keccak256
+
+log = logging.getLogger(__name__)
+
+# geth schema (reference client.py:19-32)
+header_prefix = b"h"
+body_prefix = b"b"
+num_suffix = b"n"
+block_hash_prefix = b"H"
+block_receipts_prefix = b"r"
+head_header_key = b"LastBlock"
+address_prefix = b"AM"  # account-index prefix (reference accountindexing.py)
+
+
+def rlp_decode(data: bytes):
+    """Minimal RLP decoder: bytes -> nested lists of bytes."""
+    items, _ = _rlp_decode_at(data, 0)
+    return items
+
+
+def _rlp_decode_at(data: bytes, idx: int):
+    prefix = data[idx]
+    if prefix < 0x80:
+        return bytes([prefix]), idx + 1
+    if prefix < 0xB8:
+        n = prefix - 0x80
+        return data[idx + 1 : idx + 1 + n], idx + 1 + n
+    if prefix < 0xC0:
+        lenlen = prefix - 0xB7
+        n = int.from_bytes(data[idx + 1 : idx + 1 + lenlen], "big")
+        start = idx + 1 + lenlen
+        return data[start : start + n], start + n
+    if prefix < 0xF8:
+        n = prefix - 0xC0
+    else:
+        lenlen = prefix - 0xF7
+        n = int.from_bytes(data[idx + 1 : idx + 1 + lenlen], "big")
+        idx += lenlen
+    end = idx + 1 + n
+    items = []
+    i = idx + 1
+    while i < end:
+        item, i = _rlp_decode_at(data, i)
+        items.append(item)
+    return items, end
+
+
+def _format_block_number(number: int) -> bytes:
+    return number.to_bytes(8, "big")
+
+
+class LevelDBReader:
+    """Read-level access to the geth chaindata schema (reference :46)."""
+
+    def __init__(self, db: EthDB):
+        self.db = db
+        self.head_block_header = None
+        self.head_state = None
+
+    def _get_head_block(self):
+        if self.head_block_header is None:
+            block_hash = self.db.get(head_header_key)
+            num = self._get_block_number(block_hash)
+            self.head_block_header = self._get_block_header(block_hash, num)
+        return self.head_block_header
+
+    def _get_block_number(self, block_hash: bytes) -> bytes:
+        return self.db.get(block_hash_prefix + block_hash)
+
+    def _get_block_header(self, block_hash: bytes, num: bytes):
+        header_key = header_prefix + num + block_hash
+        return rlp_decode(self.db.get(header_key))
+
+    def _get_address_by_hash(self, address_hash: bytes) -> Optional[bytes]:
+        return self.db.get(address_prefix + address_hash)
+
+    def _get_account(self, address: bytes):
+        """State-trie account lookup is geth-version dependent; the
+        reference walks the secure trie (state.py) — here we only expose
+        the account-index path used by hash_to_address."""
+        raise NotImplementedError(
+            "state-trie account traversal requires a populated account index"
+        )
+
+
+class EthLevelDB:
+    """Go-Ethereum chaindata search interface (reference client.py)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.db = EthDB(path)
+        self.reader = LevelDBReader(self.db)
+
+    def contract_hash_to_address(self, contract_hash: str) -> str:
+        """keccak(code) hex -> contract address via the account index."""
+        address_hash = binascii.a2b_hex(contract_hash.replace("0x", ""))
+        address = self.reader._get_address_by_hash(address_hash)
+        if address is None:
+            raise AddressNotFoundError
+        return "0x" + address.hex()
+
+    def search(self, expression: str, callback: Callable[[EVMContract, List[str], List[int]], None]):
+        """Scan all stored code blobs for a regex; callback per match."""
+        import re
+
+        cnt = 0
+        pattern = re.compile(expression)
+        for key, value in self.db.db:  # pragma: no cover - needs real chaindata
+            if len(value) < 2:
+                continue
+            code = "0x" + value.hex()
+            if pattern.search(code):
+                contract = EVMContract(code)
+                code_hash = "0x" + keccak256(value).hex()
+                try:
+                    address = self.contract_hash_to_address(code_hash)
+                except AddressNotFoundError:
+                    address = code_hash
+                callback(contract, [address], [0])
+            cnt += 1
+            if cnt % 1000 == 0:
+                log.info("searched %d contracts", cnt)
+
+    def eth_getCode(self, address: str) -> str:
+        raise NotImplementedError(
+            "direct state reads from LevelDB require trie traversal; use RPC"
+        )

@@ -1,0 +1,29 @@
+"""Thin LevelDB handle (parity: mythril/ethereum/interface/leveldb/eth_db.py).
+
+The C++ LevelDB binding (`plyvel`) is an optional dependency; importing
+this module without it raises a clear error only when actually used.
+"""
+
+try:
+    import plyvel  # type: ignore
+
+    _PLYVEL = True
+except ImportError:  # pragma: no cover - depends on optional native dep
+    plyvel = None
+    _PLYVEL = False
+
+
+class EthDB:
+    def __init__(self, path: str):
+        if not _PLYVEL:
+            raise ImportError(
+                "LevelDB support requires the optional 'plyvel' package "
+                "(C++ LevelDB binding), which is not installed."
+            )
+        self.db = plyvel.DB(path, create_if_missing=False)
+
+    def get(self, key: bytes):
+        return self.db.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.db.put(key, value)

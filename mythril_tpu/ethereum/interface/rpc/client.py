@@ -1,0 +1,127 @@
+"""Minimal Ethereum JSON-RPC client.
+
+Parity: mythril/ethereum/interface/rpc/client.py:30 (`EthJsonRpc`) and
+base_client.py:19 — the subset of eth_* methods the analyzer uses for
+on-chain analysis (code/storage/balance/block lookups), over HTTPS via
+`requests`. No websockets, no batching: the DynLoader caches aggressively
+(mythril/support/loader.py:27) so call volume is low.
+"""
+
+import json
+from typing import Any, List, Optional
+
+import requests
+
+from mythril_tpu.ethereum.interface.rpc.exceptions import (
+    BadJsonError,
+    BadResponseError,
+    BadStatusCodeError,
+    ConnectionError as RpcConnectionError,
+)
+
+JSON_MEDIA_TYPE = "application/json"
+BLOCK_TAG_LATEST = "latest"
+
+
+def hex_to_dec(x: str) -> int:
+    return int(x, 16)
+
+
+def clean_hex(d: int) -> str:
+    return hex(d).rstrip("L")
+
+
+def validate_block(block) -> str:
+    if isinstance(block, str):
+        if block not in ("latest", "earliest", "pending"):
+            raise ValueError(
+                'invalid block tag, must be "latest", "earliest" or "pending"'
+            )
+        return block
+    if isinstance(block, int):
+        return hex(block)
+    raise ValueError("invalid block specifier")
+
+
+class BaseClient:
+    """Shared convenience wrappers over the raw `_call`."""
+
+    def _call(self, method: str, params: Optional[List[Any]] = None, _id: int = 1):
+        raise NotImplementedError
+
+    def eth_coinbase(self) -> str:
+        return self._call("eth_coinbase")
+
+    def eth_blockNumber(self) -> int:
+        return hex_to_dec(self._call("eth_blockNumber"))
+
+    def eth_getBalance(self, address, block=BLOCK_TAG_LATEST) -> int:
+        return hex_to_dec(
+            self._call("eth_getBalance", [address, validate_block(block)])
+        )
+
+    def eth_getStorageAt(self, address, position=0, block=BLOCK_TAG_LATEST) -> str:
+        return self._call(
+            "eth_getStorageAt", [address, hex(position), validate_block(block)]
+        )
+
+    def eth_getCode(self, address, default_block=BLOCK_TAG_LATEST) -> str:
+        return self._call("eth_getCode", [address, validate_block(default_block)])
+
+    def eth_getTransactionCount(self, address, block=BLOCK_TAG_LATEST) -> int:
+        return hex_to_dec(
+            self._call("eth_getTransactionCount", [address, validate_block(block)])
+        )
+
+    def eth_getBlockByNumber(self, block=BLOCK_TAG_LATEST, tx_objects: bool = True):
+        return self._call("eth_getBlockByNumber", [validate_block(block), tx_objects])
+
+    def eth_getTransactionReceipt(self, tx_hash: str):
+        return self._call("eth_getTransactionReceipt", [tx_hash])
+
+
+class EthJsonRpc(BaseClient):
+    """JSON-RPC over HTTP(S) (reference: rpc/client.py:30)."""
+
+    def __init__(self, host: str = "localhost", port: int = 8545, tls: bool = False):
+        self.host = host
+        self.port = port
+        self.tls = tls
+        self.session = requests.Session()
+
+    @property
+    def _url(self) -> str:
+        proto = "https" if self.tls else "http"
+        host = self.host
+        # accept "host/path" style endpoints (e.g. infura project URLs)
+        if self.port in (None, 0, 443, 80) and "/" in host:
+            return f"{proto}://{host}"
+        return f"{proto}://{host}:{self.port}"
+
+    def _call(self, method: str, params: Optional[List[Any]] = None, _id: int = 1):
+        params = params or []
+        data = {"jsonrpc": "2.0", "method": method, "params": params, "id": _id}
+        try:
+            r = self.session.post(
+                self._url,
+                headers={"Content-Type": JSON_MEDIA_TYPE},
+                data=json.dumps(data),
+                timeout=30,
+            )
+        except requests.exceptions.RequestException as e:
+            raise RpcConnectionError(str(e))
+        if r.status_code // 100 != 2:
+            raise BadStatusCodeError(r.status_code)
+        try:
+            response = r.json()
+        except ValueError:
+            raise BadJsonError(r.text)
+        if "error" in response and response["error"]:
+            raise BadResponseError(response["error"])
+        try:
+            return response["result"]
+        except KeyError:
+            raise BadResponseError(response)
+
+    def close(self) -> None:
+        self.session.close()

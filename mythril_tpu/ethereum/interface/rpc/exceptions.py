@@ -1,0 +1,21 @@
+"""JSON-RPC client exceptions (parity: mythril/ethereum/interface/rpc/exceptions.py)."""
+
+
+class EthJsonRpcError(Exception):
+    """Base RPC error."""
+
+
+class ConnectionError(EthJsonRpcError):
+    """Transport-level failure talking to the node."""
+
+
+class BadStatusCodeError(EthJsonRpcError):
+    """Non-200 HTTP status from the node."""
+
+
+class BadJsonError(EthJsonRpcError):
+    """Response body was not valid JSON."""
+
+
+class BadResponseError(EthJsonRpcError):
+    """JSON-RPC level error or malformed envelope."""

@@ -1,0 +1,98 @@
+"""Solidity compiler invocation + byte helpers.
+
+Parity: mythril/ethereum/util.py (get_solc_json :19, safe_decode :55,
+get_indexed_address) — the reference shells out to the `solc` binary with
+--standard-json; so do we (no compiler is linked in)."""
+
+import binascii
+import json
+import os
+import subprocess
+from pathlib import Path
+from subprocess import PIPE, Popen
+
+from mythril_tpu.exceptions import CompilerError
+
+
+def get_solc_json(file: str, solc_binary: str = "solc", solc_settings_json: str = None):
+    """Compile `file` with solc --standard-json and return the output dict."""
+    settings = {}
+    if solc_settings_json:
+        with open(solc_settings_json) as f:
+            settings = json.load(f)
+    settings.setdefault("optimizer", {"enabled": False})
+    settings["outputSelection"] = {
+        "*": {
+            "*": ["metadata", "evm.bytecode", "evm.deployedBytecode", "abi"],
+            "": ["ast"],
+        }
+    }
+    input_json = json.dumps(
+        {
+            "language": "Solidity",
+            "sources": {file: {"urls": [file]}},
+            "settings": settings,
+        }
+    )
+    try:
+        p = Popen(
+            [solc_binary, "--standard-json", "--allow-paths", "."],
+            stdin=PIPE,
+            stdout=PIPE,
+            stderr=PIPE,
+        )
+        stdout, stderr = p.communicate(bytes(input_json, "utf8"))
+    except FileNotFoundError:
+        raise CompilerError(
+            f"Compiler not found. Make sure `{solc_binary}` is installed and in PATH."
+        )
+    try:
+        result = json.loads(stdout.decode("utf8"))
+    except json.JSONDecodeError:
+        raise CompilerError(f"Encountered a decoding error: {stderr.decode('utf8')}")
+    for error in result.get("errors", []):
+        if error["severity"] == "error":
+            raise CompilerError(
+                "Solc experienced a fatal error.\n\n%s" % error["formattedMessage"]
+            )
+    return result
+
+
+def get_random_address() -> str:
+    return binascii.b2a_hex(os.urandom(20)).decode("UTF-8")
+
+
+def get_indexed_address(index: int) -> str:
+    return "0x" + (hex(index)[2:] * 40)[:40]
+
+
+def safe_decode(hex_encoded_string: str) -> bytes:
+    if hex_encoded_string.startswith("0x"):
+        return bytes.fromhex(hex_encoded_string[2:])
+    return bytes.fromhex(hex_encoded_string)
+
+
+def extract_version(file: str):
+    """Best-effort pragma scan so the CLI can hint at the right solc."""
+    version_line = None
+    for line in Path(file).read_text(errors="ignore").splitlines():
+        if "pragma solidity" in line:
+            version_line = line.rstrip()
+            break
+    if not version_line:
+        return None
+    assert "pragma solidity" in version_line
+    return version_line.split("solidity", 1)[1].strip().rstrip(";")
+
+
+def solc_exists(version_or_binary: str = "solc") -> bool:
+    try:
+        subprocess.run(
+            [version_or_binary, "--version"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            timeout=10,
+        )
+        return True
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        return False

@@ -1,0 +1,67 @@
+"""MythX SaaS client for the `pro` command.
+
+Parity: mythril/mythx/__init__.py:22 — submits sources/bytecode to the
+MythX remote analysis API and maps responses back to `Issue`s. The
+transport dependency (`pythx`) is optional; without it (or without
+network egress) the command fails with a clear message instead of at
+import time.
+"""
+
+import logging
+import os
+from typing import List
+
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.exceptions import CriticalError
+
+log = logging.getLogger(__name__)
+
+
+def analyze(contracts, analysis_mode: str = "quick") -> List[Issue]:
+    """Submit contracts to MythX and return mapped issues."""
+    try:
+        import pythx  # type: ignore
+    except ImportError:
+        raise CriticalError(
+            "The 'pro' command requires the optional 'pythx' package and "
+            "network access to the MythX API; neither is available in this "
+            "environment."
+        )
+
+    eth_address = os.environ.get("MYTHX_ETH_ADDRESS")
+    password = os.environ.get("MYTHX_PASSWORD")
+    if not (eth_address and password):
+        eth_address = "0x0000000000000000000000000000000000000000"
+        password = "trial"
+        log.info("No MythX credentials set; using trial mode")
+
+    client = pythx.Client(eth_address=eth_address, password=password)
+    issues: List[Issue] = []
+    for contract in contracts:
+        resp = client.analyze(
+            bytecode="0x" + (contract.creation_code or contract.code),
+        )
+        while not client.analysis_ready(resp.uuid):
+            import time
+
+            time.sleep(3)
+        for report in client.report(resp.uuid):
+            for mythx_issue in getattr(report, "issues", []):
+                issues.append(
+                    Issue(
+                        contract=contract.name,
+                        function_name="unknown",
+                        address=(
+                            mythx_issue.locations[0].source_map.components[0].offset
+                            if mythx_issue.locations
+                            else 0
+                        ),
+                        swc_id=mythx_issue.swc_id.replace("SWC-", ""),
+                        title=mythx_issue.swc_title or mythx_issue.description_short,
+                        bytecode="",
+                        severity=mythx_issue.severity.name.capitalize(),
+                        description_head=mythx_issue.description_short,
+                        description_tail=mythx_issue.description_long,
+                    )
+                )
+    return issues

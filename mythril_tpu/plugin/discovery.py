@@ -1,0 +1,61 @@
+"""Entry-point plugin discovery (parity: mythril/plugin/discovery.py:8).
+
+Third-party packages register under the ``mythril_tpu.plugins`` entry
+point group; discovery is lazy and cached on the singleton.
+"""
+
+from importlib import metadata
+from typing import Any, Dict, List, Optional
+
+from mythril_tpu.plugin.interface import MythrilPlugin
+from mythril_tpu.support.support_utils import Singleton
+
+
+class PluginDiscovery(object, metaclass=Singleton):
+    """Discovers installed mythril_tpu plugins via package entry points."""
+
+    _plugins: Optional[Dict[str, Any]] = None
+
+    @property
+    def loaded_plugins(self) -> Dict[str, Any]:
+        if self._plugins is not None:
+            return self._plugins
+        plugins = {}
+        try:
+            eps = metadata.entry_points()
+            group = (
+                eps.select(group="mythril_tpu.plugins")
+                if hasattr(eps, "select")
+                else eps.get("mythril_tpu.plugins", [])
+            )
+            for ep in group:
+                try:
+                    plugins[ep.name] = ep.load()
+                except Exception:  # a broken plugin must not break the CLI
+                    plugins[ep.name] = None
+        except Exception:
+            pass
+        self._plugins = plugins
+        return plugins
+
+    def is_installed(self, plugin_name: str) -> bool:
+        return plugin_name in self.loaded_plugins
+
+    def build_plugin(self, plugin_name: str, plugin_args: Dict) -> MythrilPlugin:
+        if not self.is_installed(plugin_name):
+            raise ValueError(f"Plugin with name: `{plugin_name}` is not installed")
+        plugin = self.loaded_plugins.get(plugin_name)
+        if plugin is None or not issubclass(plugin, MythrilPlugin):
+            raise ValueError(f"No valid plugin was found for {plugin_name}")
+        return plugin(**plugin_args)
+
+    def get_plugins(self, default_enabled: Optional[bool] = None) -> List[str]:
+        """Installed plugin names, optionally filtered by default_enabled."""
+        if default_enabled is None:
+            return list(self.loaded_plugins.keys())
+        return [
+            name
+            for name, plugin in self.loaded_plugins.items()
+            if plugin is not None
+            and getattr(plugin, "plugin_default_enabled", False) == default_enabled
+        ]

@@ -1,0 +1,29 @@
+"""Third-party plugin interface (parity: mythril/plugin/interface.py:4)."""
+
+from abc import ABC
+
+
+class MythrilPlugin(ABC):
+    """Base class for installable plugins.
+
+    Plugin packages expose instances through the
+    ``mythril_tpu.plugins`` entry point; detection-module plugins
+    additionally subclass DetectionModule (see plugin/loader.py).
+    """
+
+    author = "Default Author"
+    name = "Plugin Name"
+    plugin_license = "All rights reserved."
+    plugin_type = "Mythril Plugin"
+    plugin_version = "0.0.1 "
+    plugin_description = "This is an example plugin description"
+
+    def __init__(self, **kwargs):
+        pass
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+class MythrilCLIPlugin(MythrilPlugin):
+    """Plugins hooking the CLI (reserved surface)."""

@@ -1,0 +1,49 @@
+"""Plugin loading into the analysis pipeline.
+
+Parity: mythril/plugin/loader.py:18 — currently DetectionModule plugins
+are supported (loader.py:36-40); they are appended to the ModuleLoader's
+registered modules and then behave exactly like built-ins.
+"""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.plugin.discovery import PluginDiscovery
+from mythril_tpu.plugin.interface import MythrilCLIPlugin, MythrilPlugin
+from mythril_tpu.support.support_utils import Singleton
+
+log = logging.getLogger(__name__)
+
+
+class UnsupportedPluginType(Exception):
+    pass
+
+
+class MythrilPluginLoader(object, metaclass=Singleton):
+    """Loads installed plugins and wires them into the right subsystem."""
+
+    def __init__(self):
+        self.loaded_plugins = []
+        self._load_default_enabled()
+
+    def load(self, plugin: MythrilPlugin):
+        if not isinstance(plugin, MythrilPlugin):
+            raise ValueError("Passed plugin is not of type MythrilPlugin")
+        log.info("Loading plugin: %s", plugin.name)
+        if isinstance(plugin, DetectionModule):
+            self._load_detection_module(plugin)
+        else:
+            raise UnsupportedPluginType("Passed plugin type is not yet supported")
+        self.loaded_plugins.append(plugin)
+        log.info("Finished loading plugin: %s", plugin.name)
+
+    @staticmethod
+    def _load_detection_module(plugin):
+        ModuleLoader().register_module(plugin)
+
+    def _load_default_enabled(self):
+        log.info("Loading installed analysis modules that are enabled by default")
+        for plugin_name in PluginDiscovery().get_plugins(default_enabled=True):
+            plugin = PluginDiscovery().build_plugin(plugin_name, {})
+            self.load(plugin)

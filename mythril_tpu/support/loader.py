@@ -1,0 +1,60 @@
+"""DynLoader: lazy on-chain state loading.
+
+Parity: mythril/support/loader.py:15 — storage/balance/code reads against
+a JSON-RPC node, memoized with lru_cache so symbolic execution touching
+the same account repeatedly costs one network round trip.
+"""
+
+import functools
+import logging
+from typing import Optional
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+
+log = logging.getLogger(__name__)
+
+
+class DynLoaderError(Exception):
+    pass
+
+
+class DynLoader:
+    """On-demand chain-state loader (reference: support/loader.py:15)."""
+
+    def __init__(self, eth, active: bool = True):
+        self.eth = eth
+        self.active = active
+
+    @functools.lru_cache(maxsize=4096)
+    def read_storage(self, contract_address: str, index: int) -> str:
+        if not self.active:
+            raise DynLoaderError("Dynamic loading set to false")
+        if self.eth is None:
+            raise DynLoaderError("Dynamic loader is not set up properly.")
+        value = self.eth.eth_getStorageAt(
+            contract_address, position=index, block="latest"
+        )
+        if value.startswith("0x"):
+            value = value[2:]
+        return value
+
+    @functools.lru_cache(maxsize=4096)
+    def read_balance(self, address: str) -> int:
+        if not self.active:
+            raise DynLoaderError("Dynamic loading set to false")
+        if self.eth is None:
+            raise DynLoaderError("Dynamic loader is not set up properly.")
+        return self.eth.eth_getBalance(address)
+
+    @functools.lru_cache(maxsize=4096)
+    def dynld(self, dependency_address: str) -> Optional[Disassembly]:
+        """Fetch an account's code and return its Disassembly (or None)."""
+        if not self.active:
+            raise DynLoaderError("Dynamic loading set to false")
+        if self.eth is None:
+            raise DynLoaderError("Dynamic loader is not set up properly.")
+        log.debug("Dynld at contract %s", dependency_address)
+        code = self.eth.eth_getCode(dependency_address)
+        if code in (None, "", "0x", "0x0"):
+            return None
+        return Disassembly(code)
