@@ -20,6 +20,11 @@ def get_solc_json(file: str, solc_binary: str = "solc", solc_settings_json: str 
     if solc_settings_json:
         with open(solc_settings_json) as f:
             settings = json.load(f)
+    # The reference passes --optimize on the CLI (mythril/ethereum/util.py:38)
+    # but combines it with --standard-json, where solc ignores CLI optimizer
+    # flags — its effective output is UNoptimized. Default to the same
+    # effective behavior so bytecode/source maps match for the same input;
+    # callers opt in via solc_settings_json.
     settings.setdefault("optimizer", {"enabled": False})
     settings["outputSelection"] = {
         "*": {

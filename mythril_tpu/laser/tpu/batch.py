@@ -197,13 +197,17 @@ def _fill_lane(
         raise ValueError("calldata exceeds batch capacity")
     np_batch["alive"][lane] = True
     np_batch["status"][lane] = RUNNING
+    np_batch["trap_op"][lane] = 0
     np_batch["pc"][lane] = 0
     np_batch["code_id"][lane] = code_id
+    np_batch["stack"][lane] = 0
     np_batch["sp"][lane] = 0
     np_batch["memory"][lane] = 0
     np_batch["mem_words"][lane] = 0
     np_batch["gas_left"][lane] = gas
     np_batch["storage_used"][lane] = False
+    np_batch["ret_off"][lane] = 0
+    np_batch["ret_len"][lane] = 0
     np_batch["calldata"][lane] = 0
     np_batch["calldata"][lane, : len(calldata)] = np.frombuffer(bytes(calldata), np.uint8)
     np_batch["calldata_len"][lane] = len(calldata)

@@ -187,8 +187,11 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
     gas_mem = jnp.where(touches, _mem_gas(st.mem_words, new_mem_words), 0).astype(U32)
 
-    # RETURNDATACOPY with len>0 needs call returndata -> host
-    retcopy_trap = is_retcopy & (c32 > 0)
+    # RETURNDATACOPY: no call has occurred on-device (CALL traps), so
+    # RETURNDATASIZE is 0 and EIP-211 requires offset+length <= 0. Any
+    # nonzero offset OR length leaves the device model (len>0 needs real
+    # returndata; off>0 len==0 must raise, not no-op) — the host decides.
+    retcopy_trap = is_retcopy & ((b32 > 0) | (c32 > 0))
 
     # ------------------------------------------------------------------
     # ALU (cheap families, unconditional)

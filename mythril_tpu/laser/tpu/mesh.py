@@ -52,26 +52,27 @@ def put_replicated(tree, mesh: Mesh):
     return jax.device_put(tree, replicated(mesh))
 
 
-def rebalance(st: StateBatch) -> StateBatch:
-    """Globally permute lanes so running work packs evenly across shards.
+def rebalance(st: StateBatch, n_shards: int = 1) -> StateBatch:
+    """Globally permute lanes so running work deals evenly across shards.
 
-    Sorts lanes by (not running) then by a round-robin spreading key, so
-    live lanes end up striped across devices. Under GSPMD on a sharded
-    lane axis this lowers to cross-device all-to-all — the explicit
-    work-stealing collective.
+    Stable-partitions lanes (running first), then deals the packed prefix
+    round-robin across the ``n_shards`` contiguous per-device blocks:
+    output slot ``s*per_shard + k`` of shard ``s`` receives packed lane
+    ``k*n_shards + s``, so R running lanes land ⌈R/n⌉-or-⌊R/n⌋ per shard.
+    Under GSPMD on a sharded lane axis this lowers to cross-device
+    all-to-all — the explicit work-stealing collective. With fewer than 2
+    shards, or a lane count not divisible by the shard count, packing
+    without dealing would CONCENTRATE work on shard 0 (worse than doing
+    nothing), so we skip entirely.
     """
     L = st.pc.shape[0]
-    # Stable partition (running lanes first) followed by a stride
-    # interleave that deals the packed prefix round-robin across the
-    # contiguous per-device blocks. Without the interleave the argsort
-    # alone would CONCENTRATE running lanes on shard 0 — worse than no
-    # permutation — so when no usable stride exists, skip entirely.
-    stride = min(64, L & (-L))  # largest power of two dividing L, capped
-    if stride < 2:
+    if n_shards < 2 or L % n_shards != 0:
         return st
+    per_shard = L // n_shards
     running = st.alive & (st.status == RUNNING)
     order = jnp.argsort(~running, stable=True)
-    deal = jnp.arange(L).reshape(stride, L // stride).T.reshape(-1)
+    # deal[s*per_shard + k] = k*n_shards + s
+    deal = jnp.arange(L).reshape(per_shard, n_shards).T.reshape(-1)
     order = order[deal]
 
     def permute(x):
@@ -80,18 +81,51 @@ def rebalance(st: StateBatch) -> StateBatch:
     return jax.tree_util.tree_map(permute, st)
 
 
+def occupancy(st: StateBatch, n_shards: int) -> np.ndarray:
+    """Per-shard running-lane counts (host-side rebalance gating)."""
+    running = np.asarray(st.alive & (st.status == RUNNING))
+    if running.shape[0] % n_shards != 0:
+        raise ValueError(
+            f"lane count {running.shape[0]} not divisible by n_shards {n_shards}"
+        )
+    return running.reshape(n_shards, -1).sum(axis=1)
+
+
+def should_rebalance(st: StateBatch, n_shards: int) -> bool:
+    """Gate the collective: only permute when shard occupancy diverges.
+
+    SURVEY.md §5 calls for work-stealing "when lane occupancy drops below
+    threshold" — an unconditional all-to-all every round wastes ICI. A
+    perfect deal leaves max-min <= 1, so fire only when the current
+    spread is worse than that (rebalance() couldn't improve otherwise).
+    """
+    L = st.pc.shape[0]
+    if n_shards < 2 or L % n_shards != 0:
+        return False
+    occ = occupancy(st, n_shards)
+    if occ.sum() == 0:
+        return False
+    return int(occ.max()) - int(occ.min()) > 1
+
+
 def round_impl(
     cb: CodeBank,
     env: Env,
     st: StateBatch,
     steps_per_round: int = 64,
-    do_rebalance: bool = True,
+    do_rebalance: bool = False,
+    n_shards: int = 1,
 ) -> StateBatch:
     """One distributed round: local lockstep stepping, then rebalance.
 
     This is the jitted unit the driver dry-runs multi-chip: lane-local
     compute partitions cleanly; the trailing rebalance is the collective.
+    Rebalancing is opt-in: pass do_rebalance=True AND n_shards>=2 (it is
+    a deliberate cross-device permutation, and a no-op on one shard).
+    Gate rounds host-side with should_rebalance() to avoid wasting ICI.
     """
+    if do_rebalance and n_shards < 2:
+        raise ValueError("do_rebalance=True requires n_shards >= 2")
 
     def body(carry):
         t, s = carry
@@ -103,12 +137,12 @@ def round_impl(
 
     _, out = jax.lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), st))
     if do_rebalance:
-        out = rebalance(out)
+        out = rebalance(out, n_shards)
     return out
 
 
 sharded_round = jax.jit(
     round_impl,
-    static_argnames=("steps_per_round", "do_rebalance"),
+    static_argnames=("steps_per_round", "do_rebalance", "n_shards"),
     donate_argnames=("st",),
 )

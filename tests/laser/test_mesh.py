@@ -23,10 +23,69 @@ def test_rebalance_preserves_lanes():
     cb, env, st = __graft_entry__._tiny_workload(lanes=16)
     # st is donated to sharded_round — snapshot before the call
     before = sorted(map(tuple, np.asarray(st.caller).tolist()))
-    out = mesh_lib.sharded_round(cb, env, st, steps_per_round=4, do_rebalance=True)
+    out = mesh_lib.sharded_round(
+        cb, env, st, steps_per_round=4, do_rebalance=True, n_shards=8
+    )
     # every original lane must still exist exactly once (permutation only)
     after = sorted(map(tuple, np.asarray(out.caller).tolist()))
     assert before == after
+
+
+def test_rebalance_deals_running_lanes_evenly():
+    # 64 lanes, 8 shards: concentrate all running work on shard 0 and in
+    # scattered spots, then check the deal spreads it across every shard
+    # (the ADVICE.md round-1 finding: the old stride interleave was the
+    # identity for pow2 lane counts <= 64, concentrating work on shard 0).
+    import jax.numpy as jnp
+    from mythril_tpu.laser.tpu.batch import BatchConfig, empty_batch
+
+    n_shards, per_shard = 8, 8
+    L = n_shards * per_shard
+    cfg = BatchConfig(lanes=L, stack_slots=4, memory_bytes=32,
+                      calldata_bytes=32, storage_slots=2, code_len=32)
+    st = empty_batch(cfg)
+    running_idx = list(range(10)) + [17, 23, 31]  # 13 running lanes, skewed
+    alive = np.zeros(L, bool)
+    alive[running_idx] = True
+    st = st._replace(
+        alive=jnp.asarray(alive),
+        status=jnp.zeros(L, jnp.int32),  # RUNNING
+        # tag lanes so we can track the permutation
+        pc=jnp.arange(L, dtype=jnp.int32),
+    )
+    out = mesh_lib.rebalance(st, n_shards=n_shards)
+    occ = mesh_lib.occupancy(out, n_shards)
+    assert occ.sum() == len(running_idx)
+    assert occ.max() - occ.min() <= 1, f"uneven deal: {occ}"
+    # permutation, not duplication
+    assert sorted(np.asarray(out.pc).tolist()) == list(range(L))
+
+
+def test_should_rebalance_gating():
+    import jax.numpy as jnp
+    from mythril_tpu.laser.tpu.batch import BatchConfig, empty_batch
+
+    cfg = BatchConfig(lanes=16, stack_slots=4, memory_bytes=32,
+                      calldata_bytes=32, storage_slots=2, code_len=32)
+    st = empty_batch(cfg)
+    # 4 running lanes all in shard 0's block (max-min = 2 > 1) -> rebalance
+    alive = np.zeros(16, bool)
+    alive[:4] = True
+    skewed = st._replace(alive=jnp.asarray(alive), status=jnp.zeros(16, jnp.int32))
+    assert mesh_lib.should_rebalance(skewed, n_shards=8)
+    # evenly spread -> leave it alone
+    even = st._replace(alive=jnp.ones(16, bool), status=jnp.zeros(16, jnp.int32))
+    assert not mesh_lib.should_rebalance(even, n_shards=8)
+    # one lane per shard for the first 2 shards (max-min = 1): a deal
+    # cannot improve this end-game tail, so no collective
+    tail = np.zeros(16, bool)
+    tail[0] = tail[2] = True
+    sparse = st._replace(alive=jnp.asarray(tail), status=jnp.zeros(16, jnp.int32))
+    assert not mesh_lib.should_rebalance(sparse, n_shards=8)
+    # no work at all -> no collective
+    assert not mesh_lib.should_rebalance(st, n_shards=8)
+    # non-divisible lane count -> skip, don't crash
+    assert not mesh_lib.should_rebalance(st, n_shards=3)
 
 
 def test_sharded_round_completes_work():
@@ -36,7 +95,7 @@ def test_sharded_round_completes_work():
     cb = mesh_lib.put_replicated(cb, mesh)
     env = mesh_lib.put_replicated(env, mesh)
     for _ in range(4):
-        st = mesh_lib.sharded_round(cb, env, st, steps_per_round=32)
+        st = mesh_lib.sharded_round(cb, env, st, steps_per_round=32, n_shards=8)
     status = np.asarray(st.status)
     alive = np.asarray(st.alive)
     assert not ((status == RUNNING) & alive).any()
