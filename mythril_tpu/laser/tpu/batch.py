@@ -43,6 +43,9 @@ class BatchConfig(NamedTuple):
     calldata_bytes: int = 512
     storage_slots: int = 32
     code_len: int = 8192
+    tape_slots: int = 256  # symbolic term-tape rows per lane
+    path_slots: int = 64  # path-condition entries per lane
+    mem_sym_slots: int = 16  # 32-byte symbolic memory-overlay words per lane
 
 
 class CodeBank(NamedTuple):
@@ -91,6 +94,30 @@ class StateBatch(NamedTuple):
     address: jnp.ndarray  # u32[L, 16]
     balance: jnp.ndarray  # u32[L, 16] self-balance
     steps: jnp.ndarray  # i32[L] instructions retired in this lane
+    # ---- symbolic layer (laser/tpu/symtape.py). Tags are 1-based tape
+    # ids; 0 = concrete (the word/byte planes are authoritative).
+    stack_sym: jnp.ndarray  # i32[L, S]
+    tape_op: jnp.ndarray  # i32[L, T]
+    tape_a: jnp.ndarray  # i32[L, T]
+    tape_b: jnp.ndarray  # i32[L, T]
+    tape_imm: jnp.ndarray  # u32[L, T, 16]
+    tape_len: jnp.ndarray  # i32[L]
+    path_id: jnp.ndarray  # i32[L, P] branch-condition tape ids
+    path_sign: jnp.ndarray  # bool[L, P] True = condition word != 0
+    path_len: jnp.ndarray  # i32[L]
+    msym_off: jnp.ndarray  # i32[L, MS] byte offset of a symbolic mem word
+    msym_id: jnp.ndarray  # i32[L, MS]
+    msym_used: jnp.ndarray  # bool[L, MS]
+    skey_sym: jnp.ndarray  # i32[L, K] storage key tags
+    sval_sym: jnp.ndarray  # i32[L, K] storage value tags
+    calldata_symbolic: jnp.ndarray  # bool[L] calldata is a free symbol plane
+    storage_symbolic: jnp.ndarray  # bool[L] world storage is symbolic
+    cdsize_sym: jnp.ndarray  # i32[L] tag for CALLDATASIZE
+    caller_sym: jnp.ndarray  # i32[L]
+    callvalue_sym: jnp.ndarray  # i32[L]
+    origin_sym: jnp.ndarray  # i32[L]
+    balance_sym: jnp.ndarray  # i32[L]
+    seed_id: jnp.ndarray  # i32[L] host-side id of the seeding state
 
 
 def batch_shapes(cfg: BatchConfig) -> dict:
@@ -102,6 +129,7 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         cfg.calldata_bytes,
         cfg.storage_slots,
     )
+    T, P, MS = cfg.tape_slots, cfg.path_slots, cfg.mem_sym_slots
     D = words.NDIGITS
     word = ((L, D), np.uint32)
     return {
@@ -128,6 +156,28 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "address": word,
         "balance": word,
         "steps": ((L,), np.int32),
+        "stack_sym": ((L, S), np.int32),
+        "tape_op": ((L, T), np.int32),
+        "tape_a": ((L, T), np.int32),
+        "tape_b": ((L, T), np.int32),
+        "tape_imm": ((L, T, D), np.uint32),
+        "tape_len": ((L,), np.int32),
+        "path_id": ((L, P), np.int32),
+        "path_sign": ((L, P), np.bool_),
+        "path_len": ((L,), np.int32),
+        "msym_off": ((L, MS), np.int32),
+        "msym_id": ((L, MS), np.int32),
+        "msym_used": ((L, MS), np.bool_),
+        "skey_sym": ((L, K), np.int32),
+        "sval_sym": ((L, K), np.int32),
+        "calldata_symbolic": ((L,), np.bool_),
+        "storage_symbolic": ((L,), np.bool_),
+        "cdsize_sym": ((L,), np.int32),
+        "caller_sym": ((L,), np.int32),
+        "callvalue_sym": ((L,), np.int32),
+        "origin_sym": ((L,), np.int32),
+        "balance_sym": ((L,), np.int32),
+        "seed_id": ((L,), np.int32),
     }
 
 
@@ -178,6 +228,33 @@ def default_env() -> Env:
     )
 
 
+def append_node(np_batch: dict, lane: int, op: int, a: int = 0, b: int = 0, imm=None) -> int:
+    """Host helper: append one term-tape node to a lane; returns 1-based id.
+
+    Performs the same CSE as the device allocator (symtape.alloc) so host
+    packing and device stepping agree on node identity.
+    """
+    T = np_batch["tape_op"].shape[1]
+    n = int(np_batch["tape_len"][lane])
+    imm_row = np.zeros(words.NDIGITS, np.uint32) if imm is None else np.asarray(imm, np.uint32)
+    for j in range(n):
+        if (
+            np_batch["tape_op"][lane, j] == op
+            and np_batch["tape_a"][lane, j] == a
+            and np_batch["tape_b"][lane, j] == b
+            and (np_batch["tape_imm"][lane, j] == imm_row).all()
+        ):
+            return j + 1
+    if n >= T:
+        raise ValueError(f"lane {lane} term tape full ({T} slots)")
+    np_batch["tape_op"][lane, n] = op
+    np_batch["tape_a"][lane, n] = a
+    np_batch["tape_b"][lane, n] = b
+    np_batch["tape_imm"][lane, n] = imm_row
+    np_batch["tape_len"][lane] = n + 1
+    return n + 1
+
+
 def _fill_lane(
     np_batch: dict,
     lane: int,
@@ -191,6 +268,12 @@ def _fill_lane(
     balance: int = 10**18,
     gas: int = 10_000_000,
     storage: Optional[dict] = None,
+    symbolic_calldata: bool = False,
+    symbolic_storage: bool = False,
+    symbolic_caller: bool = False,
+    symbolic_callvalue: bool = False,
+    symbolic_balance: bool = False,
+    seed_id: int = 0,
 ) -> None:
     C = np_batch["calldata"].shape[1]
     if len(calldata) > C:
@@ -217,6 +300,29 @@ def _fill_lane(
     np_batch["address"][lane] = words.from_int(address)
     np_batch["balance"][lane] = words.from_int(balance)
     np_batch["steps"][lane] = 0
+    # symbolic layer resets
+    for f in (
+        "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_len",
+        "path_id", "path_sign", "path_len", "msym_off", "msym_id",
+        "msym_used", "skey_sym", "sval_sym", "cdsize_sym", "caller_sym",
+        "callvalue_sym", "origin_sym", "balance_sym",
+    ):
+        np_batch[f][lane] = 0
+    np_batch["calldata_symbolic"][lane] = symbolic_calldata
+    np_batch["storage_symbolic"][lane] = symbolic_storage
+    np_batch["seed_id"][lane] = seed_id
+    from mythril_tpu.laser.tpu import symtape
+
+    if symbolic_calldata:
+        np_batch["cdsize_sym"][lane] = append_node(np_batch, lane, symtape.OP_CDSIZE)
+    if symbolic_caller:
+        tag = append_node(np_batch, lane, symtape.OP_CALLER)
+        np_batch["caller_sym"][lane] = tag
+        np_batch["origin_sym"][lane] = append_node(np_batch, lane, symtape.OP_ORIGIN)
+    if symbolic_callvalue:
+        np_batch["callvalue_sym"][lane] = append_node(np_batch, lane, symtape.OP_CALLVALUE)
+    if symbolic_balance:
+        np_batch["balance_sym"][lane] = append_node(np_batch, lane, symtape.OP_BALANCE)
     if storage:
         if len(storage) > np_batch["storage_used"].shape[1]:
             raise ValueError("storage exceeds batch slot capacity")
@@ -252,15 +358,83 @@ def load_lane(st: StateBatch, lane: int, **kwargs) -> StateBatch:
 
 
 def read_memory(st: StateBatch, lane: int, off: int, length: int) -> bytes:
+    """Concrete byte plane only — symbolic overlay words read as zeros.
+
+    Use read_memory_sym when the lane may hold symbolic memory (e.g.
+    unpacking RETURN data of a symbolic run).
+    """
     return bytes(np.asarray(st.memory)[lane, off : off + length])
 
 
+def read_memory_sym(st: StateBatch, lane: int, off: int, length: int):
+    """(bytes, [(relative offset, tape id)]) — overlay-aware memory read.
+
+    The byte plane is zero under each listed 32-byte symbolic word; the
+    tape ids index the lane's term tape (1-based, see read_tape).
+    """
+    data = bytes(np.asarray(st.memory)[lane, off : off + length])
+    used = np.asarray(st.msym_used)[lane]
+    offs = np.asarray(st.msym_off)[lane]
+    ids = np.asarray(st.msym_id)[lane]
+    overlay = [
+        (int(offs[j]) - off, int(ids[j]))
+        for j in range(used.shape[0])
+        if used[j] and offs[j] + 32 > off and offs[j] < off + length
+    ]
+    return data, sorted(overlay)
+
+
+def read_path(st: StateBatch, lane: int):
+    """Host helper: lane's path condition as [(tape id, polarity)]."""
+    n = int(np.asarray(st.path_len)[lane])
+    ids = np.asarray(st.path_id)[lane, :n]
+    signs = np.asarray(st.path_sign)[lane, :n]
+    return [(int(i), bool(s)) for i, s in zip(ids, signs)]
+
+
+def read_tape(st: StateBatch, lane: int):
+    """Host helper: lane's term tape as [(op, a, b, imm_int)] rows."""
+    n = int(np.asarray(st.tape_len)[lane])
+    ops = np.asarray(st.tape_op)[lane, :n]
+    aa = np.asarray(st.tape_a)[lane, :n]
+    bb = np.asarray(st.tape_b)[lane, :n]
+    imms = np.asarray(st.tape_imm)[lane, :n]
+    return [
+        (int(o), int(a), int(b), words.to_int(im))
+        for o, a, b, im in zip(ops, aa, bb, imms)
+    ]
+
+
 def read_storage_dict(st: StateBatch, lane: int) -> dict:
+    """Fully-concrete storage entries only (symbolic keys/values skipped).
+
+    Use read_storage_full when the lane ran symbolically.
+    """
     used = np.asarray(st.storage_used)[lane]
     keys = np.asarray(st.storage_key)[lane]
     vals = np.asarray(st.storage_val)[lane]
+    ksym = np.asarray(st.skey_sym)[lane]
+    vsym = np.asarray(st.sval_sym)[lane]
     return {
         words.to_int(keys[j]): words.to_int(vals[j])
         for j in range(used.shape[0])
-        if used[j]
+        if used[j] and ksym[j] == 0 and vsym[j] == 0
     }
+
+
+def read_storage_full(st: StateBatch, lane: int):
+    """All associative entries: [(key_int, val_int, key_tag, val_tag)].
+
+    A nonzero tag means the corresponding int is a zeroed placeholder and
+    the tape node (1-based id, see read_tape) is authoritative.
+    """
+    used = np.asarray(st.storage_used)[lane]
+    keys = np.asarray(st.storage_key)[lane]
+    vals = np.asarray(st.storage_val)[lane]
+    ksym = np.asarray(st.skey_sym)[lane]
+    vsym = np.asarray(st.sval_sym)[lane]
+    return [
+        (words.to_int(keys[j]), words.to_int(vals[j]), int(ksym[j]), int(vsym[j]))
+        for j in range(used.shape[0])
+        if used[j]
+    ]

@@ -1,4 +1,4 @@
-"""The batched EVM step kernel: one fused XLA computation per instruction.
+"""The batched symbolic-EVM step kernel: one fused XLA computation per step.
 
 The reference interprets one ``GlobalState`` at a time through method
 dispatch (mythril/laser/ethereum/instructions.py:211 ``Instruction.evaluate``
@@ -11,14 +11,24 @@ trade the TPU wants; the expensive families (long division, EXP,
 keccak) are gated behind ``lax.cond`` on batch-level "any lane needs it"
 predicates so their fori_loops only run when used.
 
-Semantics parity targets the reference interpreter
-(mythril/laser/ethereum/instructions.py) in concrete mode: DIV/0 = 0,
-stack limit 1024, quadratic memory gas
+Symbolic execution happens on device: values may carry 1-based tags into
+the lane's term tape (laser/tpu/symtape.py). An op with a tagged operand
+allocates a new tape node instead of computing a word; a JUMPI whose
+condition is tagged FORKS — the fall-through lane appends ¬cond to its
+path-condition tape and a free (dead) lane receives a full plane-copy of
+the state with pc=dest and cond appended. This is the device-native form
+of the reference's path fork (instructions.py:1534-1610, two state copies
+with condi/negated appended to constraints).
+
+Semantics parity targets the reference interpreter in concrete mode:
+DIV/0 = 0, stack limit 1024, quadratic memory gas
 (mythril/laser/ethereum/state/machine_state.py:136), Istanbul-ish static
 gas schedule (support/opcodes.py). Anything outside the device model —
-CALL family, CREATE, cross-account reads, oversized keccak, associative
-storage overflow — TRAPs the lane with its state intact so the host
-engine (laser/evm/) resumes it symbolically.
+CALL family, CREATE, cross-account reads, oversized or misaligned
+symbolic keccak, associative storage overflow, symbolic memory offsets,
+non-keccak symbolic storage keys, fork with no free lane — TRAPs the lane
+with its state intact (frozen *before* the trapping instruction) so the
+host engine (laser/evm/) resumes it.
 """
 
 from functools import partial
@@ -28,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mythril_tpu.laser.tpu import words
+from mythril_tpu.laser.tpu import symtape, words
 from mythril_tpu.laser.tpu.batch import (
     ERROR,
     REVERTED,
@@ -48,6 +58,7 @@ U32 = jnp.uint32
 
 EVM_STACK_LIMIT = 1024
 SHA_CAP = 544  # 4 keccak blocks; longer inputs trap to the host
+SHA_SYM_WORDS = 4  # max 32-byte words in a symbolic keccak preimage
 
 # ---------------------------------------------------------------------------
 # opcode metadata planes (host constants baked into the jitted kernel)
@@ -100,6 +111,8 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     C = st.calldata.shape[1]
     K = st.storage_key.shape[1]
     CL = cb.code.shape[1]
+    T = st.tape_op.shape[1]
+    P = st.path_id.shape[1]
     lane = jnp.arange(L)
 
     running = st.alive & (st.status == RUNNING)
@@ -120,7 +133,13 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         idx = jnp.clip(st.sp - 1 - k, 0, S - 1)
         return st.stack[lane, idx]
 
+    def peek_sym(k):
+        idx = jnp.clip(st.sp - 1 - k, 0, S - 1)
+        return jnp.where(st.sp > k, st.stack_sym[lane, idx], 0)
+
     a, b, c = peek(0), peek(1), peek(2)
+    sym_a, sym_b, sym_c = peek_sym(0), peek_sym(1), peek_sym(2)
+    has_a, has_b, has_c = sym_a > 0, sym_b > 0, sym_c > 0
 
     # ------------------------------------------------------------------
     # stack discipline
@@ -129,12 +148,16 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     model_overflow = new_sp > S  # batch capacity: trap, host takes over
     evm_overflow = new_sp > EVM_STACK_LIMIT
 
+    ok_lane = running & ~underflow  # base mask for tape allocations
+
     # ------------------------------------------------------------------
     # offsets: i32 views of the top operands for memory/jump addressing.
     # Values >= 2^31 would go negative in i32 and slip past range checks,
     # so "fits" means fits-in-i31; non-fitting operands are clamped to a
     # large positive sentinel (safely past every capacity bound, and still
-    # small enough that sentinel + sentinel cannot wrap i32).
+    # small enough that sentinel + sentinel cannot wrap i32). For tagged
+    # (symbolic) operands the word plane is garbage — every consumer of
+    # a32/b32/c32 below must either trap on the tag or ignore the lane.
     _SENT = I32(1 << 28)
 
     def off_view(w):
@@ -158,6 +181,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     is_mstore = opmask(0x52)
     is_mstore8 = opmask(0x53)
     is_sha3 = opmask(0x20)
+    is_cdload = opmask(0x35)
     is_cdcopy = opmask(0x37)
     is_codecopy = opmask(0x39)
     is_retcopy = opmask(0x3E)
@@ -274,9 +298,32 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             None,
         ),
     )
-    # EXP dynamic gas: 50 per exponent byte (EIP-160)
+    # EXP dynamic gas: 50 per exponent byte (EIP-160). Symbolic exponent:
+    # byte length unknown, charge the minimum (0 bytes) — the device gas
+    # counter models min-gas; the host tracks the max bound.
     exp_bytes = _byte_length(b)
-    gas_exp = jnp.where(is_exp, 50 * exp_bytes, 0).astype(U32)
+    gas_exp = jnp.where(is_exp & ~has_b, 50 * exp_bytes, 0).astype(U32)
+
+    # ------------------------------------------------------------------
+    # symbolic ALU: any tagged operand of a mapped opcode allocates one
+    # tape node (the concrete operand, if any, rides inline in imm)
+    tapes = (st.tape_op, st.tape_a, st.tape_b, st.tape_imm, st.tape_len)
+    sym_opt = jnp.asarray(symtape.SYM_OP)[op]
+    sym_ar = jnp.asarray(symtape.SYM_ARITY)[op]
+    alu_sym_mask = (
+        ok_lane
+        & (sym_opt > 0)
+        & (((sym_ar == 1) & has_a) | ((sym_ar == 2) & (has_a | has_b)))
+    )
+    node_a = jnp.where(has_a, sym_a, symtape.ARG_IMM)
+    node_b = jnp.where(sym_ar == 2, jnp.where(has_b, sym_b, symtape.ARG_IMM), 0)
+    both_or_unary = has_a & (has_b | (sym_ar == 1))
+    imm_alu = jnp.where(
+        both_or_unary[:, None], jnp.zeros_like(a), jnp.where(has_a[:, None], b, a)
+    )
+    tapes, alu_id, alu_ok = symtape.alloc(
+        tapes, alu_sym_mask, sym_opt, node_a, node_b, imm_alu
+    )
 
     # ------------------------------------------------------------------
     # environment / block pushes
@@ -303,9 +350,9 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     gas_after_self = jnp.where(st.gas_left >= 2, st.gas_left - 2, U32(0))
     res = _sel(res, opmask(0x5A), words.from_u32(gas_after_self))
 
-    # BALANCE: on-device only for self-address
+    # BALANCE: on-device only for self-address with a concrete argument
     is_balance = opmask(0x31)
-    self_balance_hit = is_balance & words.eq(a, st.address)
+    self_balance_hit = is_balance & ~has_a & words.eq(a, st.address)
     res = _sel(res, self_balance_hit, st.balance)
     balance_trap = is_balance & ~self_balance_hit
 
@@ -318,7 +365,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         st.calldata[lane[:, None], jnp.clip(cd_idx, 0, C - 1)],
         0,
     )
-    res = _sel(res, opmask(0x35), words.from_bytes_be(cd_bytes))
+    res = _sel(res, is_cdload, words.from_bytes_be(cd_bytes))
 
     ml_idx = a32[:, None] + g32[None, :]
     ml_bytes = jnp.where(
@@ -326,59 +373,175 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
     res = _sel(res, is_mload, words.from_bytes_be(ml_bytes))
 
+    # CALLDATALOAD on symbolic calldata -> a CDLOAD leaf (offset rides
+    # inline when concrete, as a ref when itself symbolic)
+    cdload_sym_mask = ok_lane & is_cdload & st.calldata_symbolic
+    cd_node_a = jnp.where(has_a, sym_a, symtape.ARG_IMM)
+    cd_imm = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
+    tapes, cdload_id, cdload_ok = symtape.alloc(
+        tapes,
+        cdload_sym_mask,
+        jnp.full((L,), symtape.OP_CDLOAD, I32),
+        cd_node_a,
+        zero,
+        cd_imm,
+    )
+    # symbolic offset into CONCRETE calldata: data-dependent gather, host's job
+    cdload_symoff_trap = is_cdload & has_a & ~st.calldata_symbolic
+
+    # ------------------------------------------------------------------
+    # symbolic memory overlay: 32-byte words with tagged contents
+    ent_used = st.msym_used
+    ent_off = st.msym_off
+    # overlap of each entry with a 32-byte access at a32
+    e_ovl32 = ent_used & (ent_off < (a32 + 32)[:, None]) & ((ent_off + 32) > a32[:, None])
+    e_exact = ent_used & (ent_off == a32[:, None])
+    exact_any = jnp.any(e_exact, axis=-1)
+    exact_slot = jnp.argmax(e_exact, axis=-1)
+    partial_any = jnp.any(e_ovl32 & ~e_exact, axis=-1)
+
+    # MLOAD: exact-aligned symbolic word -> tag; straddling read -> host
+    mload_sym_hit = is_mload & ~has_a & exact_any
+    mload_tag = jnp.where(mload_sym_hit, st.msym_id[lane, exact_slot], 0)
+    mload_ovl_trap = is_mload & ~has_a & partial_any
+
+    # MSTORE of a symbolic value: install/replace an overlay entry
+    val_sym_mstore = is_mstore & ~has_a & has_b
+    ms_have_free = ~jnp.all(ent_used, axis=-1)
+    ms_free_slot = jnp.argmin(ent_used, axis=-1)
+    ms_slot = jnp.where(exact_any, exact_slot, ms_free_slot)
+    ms_ins_trap = val_sym_mstore & (partial_any | (~exact_any & ~ms_have_free))
+    do_ms_sym = ok_lane & val_sym_mstore & ~ms_ins_trap
+    # MSTORE of a concrete value over an exact entry: clear it; straddling
+    # a symbolic word -> host
+    mstore_conc = is_mstore & ~has_a & ~has_b
+    mstore_conc_trap = mstore_conc & partial_any
+    do_ms_clear = ok_lane & mstore_conc & exact_any
+    # MSTORE8 cannot subdivide a symbolic word
+    e_ovl1 = ent_used & (ent_off <= a32[:, None]) & ((ent_off + 32) > a32[:, None])
+    mstore8_ovl_trap = is_mstore8 & ~has_a & jnp.any(e_ovl1, axis=-1)
+    # copies into a region holding symbolic words -> host
+    e_ovl_copy = (
+        ent_used
+        & (ent_off < (a32 + c32)[:, None])
+        & ((ent_off + 32) > a32[:, None])
+    )
+    copy_ovl_trap = (
+        (is_cdcopy | is_codecopy) & ~has_a & ~has_c & (c32 > 0) & jnp.any(e_ovl_copy, axis=-1)
+    )
+
+    new_msym_off = st.msym_off.at[lane, ms_slot].set(
+        jnp.where(do_ms_sym, a32, st.msym_off[lane, ms_slot])
+    )
+    new_msym_id = st.msym_id.at[lane, ms_slot].set(
+        jnp.where(do_ms_sym, sym_b, st.msym_id[lane, ms_slot])
+    )
+    new_msym_used = st.msym_used.at[lane, ms_slot].set(
+        st.msym_used[lane, ms_slot] | do_ms_sym
+    )
+    new_msym_used = new_msym_used.at[lane, exact_slot].set(
+        jnp.where(do_ms_clear, False, new_msym_used[lane, exact_slot])
+    )
+
     # ------------------------------------------------------------------
     # PUSH1..PUSH32 immediates (+ PUSH0)
     is_push = (op >= 0x60) & (op <= 0x7F)
     k_push = jnp.where(is_push, op - 0x5F, 0)
     pj = jnp.arange(32, dtype=I32)
-    src = st.pc[:, None] + 1 + pj[None, :] - (32 - k_push[:, None])
-    pvalid = (pj[None, :] >= 32 - k_push[:, None]) & (src < my_code_len[:, None]) & (
-        src >= 0
+    src_imm = st.pc[:, None] + 1 + pj[None, :] - (32 - k_push[:, None])
+    pvalid = (pj[None, :] >= 32 - k_push[:, None]) & (src_imm < my_code_len[:, None]) & (
+        src_imm >= 0
     )
     pbytes = jnp.where(
-        pvalid, cb.code[st.code_id[:, None], jnp.clip(src, 0, CL - 1)], 0
+        pvalid, cb.code[st.code_id[:, None], jnp.clip(src_imm, 0, CL - 1)], 0
     )
     res = _sel(res, is_push, words.from_bytes_be(pbytes))
     res = _sel(res, opmask(0x5F), words.zeros((L,)))  # PUSH0
 
     # ------------------------------------------------------------------
-    # SLOAD / SSTORE (associative storage probe)
+    # SLOAD / SSTORE (associative storage probe, concrete or symbolic keys)
     is_sload = opmask(0x54)
     is_sstore = opmask(0x55)
-    key_match = st.storage_used & jnp.all(
-        st.storage_key == a[:, None, :], axis=-1
+    # symbolic keys must be keccak-rooted: mythril's keccak scheme treats
+    # distinct-input hashes as non-aliasing (keccak_function_manager.py's
+    # disjoint output intervals), which is what justifies the syntactic
+    # match below. Anything else leaves the device model.
+    probe_op = st.tape_op[lane, jnp.clip(sym_a - 1, 0, T - 1)]
+    key_sha3_ok = ~has_a | (probe_op == symtape.OP_SHA3)
+    sym_key_trap = (is_sload | is_sstore) & has_a & ~key_sha3_ok
+
+    key_match = st.storage_used & jnp.where(
+        has_a[:, None],
+        st.skey_sym == sym_a[:, None],
+        (st.skey_sym == 0) & jnp.all(st.storage_key == a[:, None, :], axis=-1),
     )  # [L, K]
     found = jnp.any(key_match, axis=-1)
     sel_slot = jnp.argmax(key_match, axis=-1)
     loaded = jnp.where(
         found[:, None], st.storage_val[lane, sel_slot], jnp.zeros_like(a)
     )
+    loaded_sym = jnp.where(found, st.sval_sym[lane, sel_slot], 0)
     res = _sel(res, is_sload, loaded)
+
+    # SLOAD miss on a symbolic world: materialize a Select(storage, key)
+    # leaf and cache it in the associative store so repeated loads agree
+    sload_leaf_mask = (
+        ok_lane & is_sload & ~found & st.storage_symbolic & key_sha3_ok
+    )
+    skey_node_a = jnp.where(has_a, sym_a, symtape.ARG_IMM)
+    skey_imm = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
+    tapes, sload_leaf_id, sload_ok = symtape.alloc(
+        tapes,
+        sload_leaf_mask,
+        jnp.full((L,), symtape.OP_SLOAD, I32),
+        skey_node_a,
+        zero,
+        skey_imm,
+    )
+    sload_tag = jnp.where(found, loaded_sym, jnp.where(sload_leaf_mask, sload_leaf_id, 0))
 
     all_used = jnp.all(st.storage_used, axis=-1)
     first_free = jnp.argmin(st.storage_used, axis=-1)
     store_slot = jnp.where(found, sel_slot, first_free)
-    storage_trap = is_sstore & ~found & all_used
-    do_store = is_sstore & ~storage_trap & running
+    need_insert = (is_sstore | sload_leaf_mask) & ~found
+    storage_trap = need_insert & all_used
+    do_store = ok_lane & (is_sstore | sload_leaf_mask) & ~storage_trap & ~sym_key_trap
+    # symbolic values zero the concrete plane (sval_sym is authoritative),
+    # so host readers can never mistake a placeholder word for a write
+    write_val = jnp.where((is_sstore & ~has_b)[:, None], b, jnp.zeros_like(b))
+    write_val_sym = jnp.where(is_sstore, sym_b, sload_leaf_id)
+    write_key_sym = jnp.where(has_a, sym_a, 0)
     new_storage_key = st.storage_key.at[lane, store_slot].set(
         jnp.where(do_store[:, None], a, st.storage_key[lane, store_slot])
     )
     new_storage_val = st.storage_val.at[lane, store_slot].set(
-        jnp.where(do_store[:, None], b, st.storage_val[lane, store_slot])
+        jnp.where(do_store[:, None], write_val, st.storage_val[lane, store_slot])
+    )
+    new_skey_sym = st.skey_sym.at[lane, store_slot].set(
+        jnp.where(do_store, write_key_sym, st.skey_sym[lane, store_slot])
+    )
+    new_sval_sym = st.sval_sym.at[lane, store_slot].set(
+        jnp.where(do_store, write_val_sym, st.sval_sym[lane, store_slot])
     )
     new_storage_used = st.storage_used.at[lane, store_slot].set(
         st.storage_used[lane, store_slot] | do_store
     )
-    # SSTORE gas: 20000 fresh nonzero, 5000 otherwise (no refund model)
+    # SSTORE gas: 20000 fresh nonzero, 5000 otherwise (no refund model).
+    # Any symbolic old/new value -> zero-ness unknown -> min (5000).
+    fresh_nonzero = (
+        (loaded_sym == 0)
+        & words.is_zero(loaded)
+        & ~(st.storage_symbolic & ~found)
+        & (sym_b == 0)
+        & ~words.is_zero(b)
+    )
     sstore_gas = jnp.where(
-        is_sstore,
-        jnp.where(words.is_zero(loaded) & ~words.is_zero(b), U32(20000), U32(5000)),
-        U32(0),
+        is_sstore, jnp.where(fresh_nonzero, U32(20000), U32(5000)), U32(0)
     )
 
     # ------------------------------------------------------------------
     # SHA3 (memory slice -> keccak, under cond)
-    sha_trap = is_sha3 & (b32 > SHA_CAP)
+    sha_trap = is_sha3 & ~has_a & ~has_b & (b32 > SHA_CAP)
 
     def do_sha(_):
         sj = jnp.arange(SHA_CAP, dtype=I32)
@@ -409,11 +572,71 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # only the per-byte data gas is dynamic
     gas_log = jnp.where(is_log, 8 * m_len.astype(U32), 0)
 
+    # SHA3 over a range containing symbolic overlay words: build a COMB
+    # chain (one node per 32-byte word, concrete words inline) and hash it
+    # symbolically — the device analog of the reference's uninterpreted
+    # keccak (keccak_function_manager.py:56). The mapping-slot pattern
+    # (MSTORE key; MSTORE slot; SHA3 0,64) lands here, and per-lane CSE
+    # makes the recomputed hash reuse the same node id so SLOAD matches.
+    sha_end = a32 + b32
+    e_rel = ent_off - a32[:, None]
+    e_in = ent_used & (e_rel >= 0) & ((ent_off + 32) <= sha_end[:, None])
+    e_aligned = (e_rel % 32) == 0
+    e_ovl_sha = ent_used & (ent_off < sha_end[:, None]) & ((ent_off + 32) > a32[:, None])
+    sha_any_sym = jnp.any(e_ovl_sha, axis=-1)
+    sha_sym_base = is_sha3 & ~has_a & ~has_b & ok_lane & sha_any_sym
+    sha_bad = (
+        jnp.any(e_ovl_sha & ~(e_in & e_aligned), axis=-1)
+        | ((b32 % 32) != 0)
+        | (b32 > 32 * SHA_SYM_WORDS)
+    )
+    sha_sym_trap = sha_sym_base & sha_bad
+    sha_sym_mask = sha_sym_base & ~sha_bad
+    nwords = b32 // 32
+
+    rest = jnp.zeros((L,), I32)
+    sha_ok = jnp.ones((L,), jnp.bool_)
+    for k in range(SHA_SYM_WORDS - 1, -1, -1):
+        woff = a32 + 32 * k
+        active = sha_sym_mask & (k < nwords)
+        we = ent_used & (ent_off == woff[:, None])
+        w_any = jnp.any(we, axis=-1)
+        w_slot = jnp.argmax(we, axis=-1)
+        w_id = st.msym_id[lane, w_slot]
+        widx = woff[:, None] + g32[None, :]
+        wbytes = jnp.where(
+            widx < M, st.memory[lane[:, None], jnp.clip(widx, 0, M - 1)], 0
+        )
+        wword = words.from_bytes_be(wbytes)
+        comb_a = jnp.where(w_any, w_id, symtape.ARG_IMM)
+        comb_imm = jnp.where(w_any[:, None], jnp.zeros_like(wword), wword)
+        tapes, comb_id, comb_ok = symtape.alloc(
+            tapes,
+            active,
+            jnp.full((L,), symtape.OP_COMB, I32),
+            comb_a,
+            rest,
+            comb_imm,
+        )
+        rest = jnp.where(active, comb_id, rest)
+        sha_ok = sha_ok & comb_ok
+    tapes, sha_id, sha3_ok = symtape.alloc(
+        tapes,
+        sha_sym_mask,
+        jnp.full((L,), symtape.OP_SHA3, I32),
+        rest,
+        zero,
+        words.from_u32(b32.astype(U32)),
+    )
+    sha_ok = sha_ok & sha3_ok
+
     # ------------------------------------------------------------------
     # DUP / SWAP
     is_dup = (op >= 0x80) & (op <= 0x8F)
     k_dup = op - 0x7F  # DUPk copies stack[sp-k]
-    dup_val = st.stack[lane, jnp.clip(st.sp - k_dup, 0, S - 1)]
+    dup_idx = jnp.clip(st.sp - k_dup, 0, S - 1)
+    dup_val = st.stack[lane, dup_idx]
+    dup_tag = st.stack_sym[lane, dup_idx]
     res = _sel(res, is_dup, dup_val)
 
     is_swap = (op >= 0x90) & (op <= 0x9F)
@@ -425,17 +648,46 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # control flow
     is_jump = opmask(0x56)
     is_jumpi = opmask(0x57)
+    jump_dest_sym_trap = (is_jump | is_jumpi) & has_a  # symbolic destination
+    cond_sym = is_jumpi & has_b & ~has_a
     dest32 = a32
     dest_ok = (
         a_fits
         & (dest32 < my_code_len)
         & cb.jumpdest[st.code_id, jnp.clip(dest32, 0, CL - 1)]
     )
-    taken = is_jump | (is_jumpi & ~words.is_zero(b))
+    taken = (is_jump | (is_jumpi & ~cond_sym & ~words.is_zero(b))) & ~has_a
     jump_err = taken & ~dest_ok
 
     pc_next = st.pc + 1 + jnp.where(is_push, k_push, 0)
     new_pc = jnp.where(taken & dest_ok, dest32, pc_next)
+
+    # symbolic JUMPI: the fall-through commits with ¬cond appended to the
+    # path tape; if the destination is a valid JUMPDEST, a free lane
+    # receives the taken branch (fork). No free lane / full path tape ->
+    # trap, frozen before the JUMPI, and the host forks instead.
+    path_ok = st.path_len < P
+    path_append = ok_lane & cond_sym & path_ok
+    path_full_trap = cond_sym & ~path_ok
+    pwidx = jnp.clip(st.path_len, 0, P - 1)
+    new_path_id = st.path_id.at[lane, pwidx].set(
+        jnp.where(path_append, sym_b, st.path_id[lane, pwidx])
+    )
+    new_path_sign = st.path_sign.at[lane, pwidx].set(
+        jnp.where(path_append, False, st.path_sign[lane, pwidx])
+    )
+    new_path_len = st.path_len + path_append.astype(I32)
+
+    # a lane that will OOG on the JUMPI itself must not consume a fork
+    # rank (it would spuriously starve a later forking lane); JUMPI's cost
+    # is purely static, so the check is exact here
+    fork_base = path_append & dest_ok & (st.gas_left >= static_gas)
+    free = ~st.alive
+    nfree = jnp.sum(free.astype(I32))
+    free_rank = jnp.cumsum(free.astype(I32)) - 1
+    req_rank = jnp.cumsum(fork_base.astype(I32)) - 1
+    has_slot = fork_base & (req_rank < nfree)
+    fork_no_slot = fork_base & ~has_slot
 
     # ------------------------------------------------------------------
     # halts
@@ -445,6 +697,29 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
 
     # ------------------------------------------------------------------
     # status resolution (order matters)
+    alloc_trap = ~(alu_ok & cdload_ok & sload_ok & sha_ok)
+    sym_trap = (
+        jump_dest_sym_trap
+        | (opmask(0x40) & has_a)  # BLOCKHASH of a symbolic number -> host
+        | (modal & (has_a | has_b | has_c))
+        | ((is_mload | is_mstore | is_mstore8) & has_a)
+        | (is_mstore8 & has_b)
+        | (is_sha3 & (has_a | has_b))
+        | ((is_return | is_revert | is_log) & (has_a | has_b))
+        | ((is_cdcopy | is_codecopy | is_retcopy) & (has_a | has_b | has_c))
+        | (is_cdcopy & st.calldata_symbolic & (c32 > 0))
+        | cdload_symoff_trap
+        | sym_key_trap
+        | mload_ovl_trap
+        | ms_ins_trap
+        | mstore_conc_trap
+        | mstore8_ovl_trap
+        | copy_ovl_trap
+        | sha_sym_trap
+        | alloc_trap
+        | path_full_trap
+        | fork_no_slot
+    )
     trap = (
         is_trap_op
         | balance_trap
@@ -452,6 +727,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         | retcopy_trap
         | storage_trap
         | sha_trap
+        | sym_trap
         | (model_overflow & ~evm_overflow)
     ) & ~is_invalid & ~underflow
     hard_err = is_invalid | underflow | evm_overflow | jump_err
@@ -481,6 +757,22 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     committed = running & ~trap & ~hard_err & ~oog
 
     # ------------------------------------------------------------------
+    # result tag: which tape node (if any) the produced value carries
+    res_sym = jnp.zeros((L,), I32)
+    res_sym = jnp.where(alu_sym_mask, alu_id, res_sym)
+    res_sym = jnp.where(cdload_sym_mask, cdload_id, res_sym)
+    res_sym = jnp.where(is_sload, sload_tag, res_sym)
+    res_sym = jnp.where(mload_sym_hit, mload_tag, res_sym)
+    res_sym = jnp.where(opmask(0x32), st.origin_sym, res_sym)
+    res_sym = jnp.where(opmask(0x33), st.caller_sym, res_sym)
+    res_sym = jnp.where(opmask(0x34), st.callvalue_sym, res_sym)
+    res_sym = jnp.where(opmask(0x36), st.cdsize_sym, res_sym)
+    res_sym = jnp.where(opmask(0x47), st.balance_sym, res_sym)
+    res_sym = jnp.where(self_balance_hit, st.balance_sym, res_sym)
+    res_sym = jnp.where(sha_sym_mask, sha_id, res_sym)
+    res_sym = jnp.where(is_dup, dup_tag, res_sym)
+
+    # ------------------------------------------------------------------
     # stack writes: every producing op leaves exactly one new value at the
     # (post-pop) top; SWAP rearranges in place instead.
     produces = (pushes > 0) & ~is_swap
@@ -492,25 +784,38 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             st.stack[lane, write_idx],
         )
     )
+    stack_sym_after = st.stack_sym.at[lane, write_idx].set(
+        jnp.where(committed & produces, res_sym, st.stack_sym[lane, write_idx])
+    )
     # SWAP: two positional writes
     swap_mask = committed & is_swap
     lo_val = st.stack[lane, swap_lo_idx]
     hi_val = st.stack[lane, swap_hi_idx]
+    lo_tag = st.stack_sym[lane, swap_lo_idx]
+    hi_tag = st.stack_sym[lane, swap_hi_idx]
     stack_after = stack_after.at[lane, swap_lo_idx].set(
         jnp.where(swap_mask[:, None], hi_val, stack_after[lane, swap_lo_idx])
     )
     stack_after = stack_after.at[lane, swap_hi_idx].set(
         jnp.where(swap_mask[:, None], lo_val, stack_after[lane, swap_hi_idx])
     )
+    stack_sym_after = stack_sym_after.at[lane, swap_lo_idx].set(
+        jnp.where(swap_mask, hi_tag, stack_sym_after[lane, swap_lo_idx])
+    )
+    stack_sym_after = stack_sym_after.at[lane, swap_hi_idx].set(
+        jnp.where(swap_mask, lo_tag, stack_sym_after[lane, swap_hi_idx])
+    )
 
     # ------------------------------------------------------------------
     # memory writes (disjoint masks, one combined commit)
     midx = jnp.arange(M, dtype=I32)[None, :]  # [1, M]
     mem = st.memory
-    # MSTORE
+    # MSTORE (symbolic values zero the byte range; the overlay holds them)
     wmask = committed & is_mstore
     in_rng = (midx >= m_off[:, None]) & (midx < m_end[:, None])
-    b_bytes = words.to_bytes_be(b).astype(jnp.uint8)  # [L, 32]
+    b_bytes = jnp.where(
+        has_b[:, None], 0, words.to_bytes_be(b)
+    ).astype(jnp.uint8)  # [L, 32]
     gather = jnp.take_along_axis(
         b_bytes, jnp.clip(midx - m_off[:, None], 0, 31), axis=-1
     )
@@ -546,8 +851,9 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         m = mask.reshape(mask.shape + (1,) * extra)
         return jnp.where(m, new, old)
 
+    tape_op_n, tape_a_n, tape_b_n, tape_imm_n, tape_len_n = tapes
     status_mask = running  # status/trap bookkeeping applies to all running lanes
-    return StateBatch(
+    nst = StateBatch(
         alive=st.alive,
         status=merge(new_status, st.status, status_mask),
         trap_op=merge(jnp.where(trap, op, st.trap_op), st.trap_op, status_mask),
@@ -571,7 +877,65 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         address=st.address,
         balance=st.balance,
         steps=merge(st.steps + 1, st.steps),
+        stack_sym=merge(stack_sym_after, st.stack_sym),
+        tape_op=merge(tape_op_n, st.tape_op),
+        tape_a=merge(tape_a_n, st.tape_a),
+        tape_b=merge(tape_b_n, st.tape_b),
+        tape_imm=merge(tape_imm_n, st.tape_imm),
+        tape_len=merge(tape_len_n, st.tape_len),
+        path_id=merge(new_path_id, st.path_id),
+        path_sign=merge(new_path_sign, st.path_sign),
+        path_len=merge(new_path_len, st.path_len),
+        msym_off=merge(new_msym_off, st.msym_off),
+        msym_id=merge(new_msym_id, st.msym_id),
+        msym_used=merge(new_msym_used, st.msym_used),
+        skey_sym=merge(new_skey_sym, st.skey_sym),
+        sval_sym=merge(new_sval_sym, st.sval_sym),
+        calldata_symbolic=st.calldata_symbolic,
+        storage_symbolic=st.storage_symbolic,
+        cdsize_sym=st.cdsize_sym,
+        caller_sym=st.caller_sym,
+        callvalue_sym=st.callvalue_sym,
+        origin_sym=st.origin_sym,
+        balance_sym=st.balance_sym,
+        seed_id=st.seed_id,
     )
+
+    # ------------------------------------------------------------------
+    # JUMPI lane forking: assign each committed forking lane a distinct
+    # free lane (rank-matching via cumsum), then one gather copies every
+    # plane of the committed fall-through state into the child, which
+    # flips to the taken branch (pc=dest, last path entry sign=True).
+    fork_do = has_slot & committed
+    free_by_rank = (
+        jnp.zeros((L,), I32)
+        .at[jnp.where(free, free_rank, L)]
+        .set(lane, mode="drop")
+    )
+    child_lane = free_by_rank[jnp.clip(req_rank, 0, L - 1)]
+    child_idx = jnp.where(fork_do, child_lane, L)  # L = dropped
+    src_map = jnp.arange(L).at[child_idx].set(lane, mode="drop")
+    child_mask = (
+        jnp.zeros((L,), jnp.bool_).at[child_idx].set(True, mode="drop")
+    )
+
+    def do_fork(_):
+        def take(x):
+            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == L:
+                return x[src_map]
+            return x
+
+        fst = jax.tree_util.tree_map(take, nst)
+        dest_g = dest32[src_map]
+        plen_idx = jnp.clip(fst.path_len - 1, 0, P - 1)
+        return fst._replace(
+            pc=jnp.where(child_mask, dest_g, fst.pc),
+            path_sign=fst.path_sign.at[lane, plen_idx].set(
+                jnp.where(child_mask, True, fst.path_sign[lane, plen_idx])
+            ),
+        )
+
+    return jax.lax.cond(jnp.any(fork_do), do_fork, lambda _: nst, None)
 
 
 step = jax.jit(step_impl)

@@ -1,0 +1,137 @@
+"""Per-lane symbolic term tapes: the device-side expression DAG.
+
+The reference carries symbolic values as z3 ASTs hanging off Python state
+objects (mythril/laser/smt/expression.py); forking deep-copies the state
+and shares the AST. On device, every lane owns a flat, append-only *term
+tape*: row ``i`` of ``tape_op/tape_a/tape_b/tape_imm[lane]`` is one DAG
+node, and stack/memory/storage cells carry 1-based tape indices as
+"symbolic tags" (tag 0 = the cell's concrete word plane is authoritative).
+
+Why per-lane (not a shared table): a lane's tape travels with the lane —
+forking a path is the same vectorized plane-copy as the stack, lanes can
+be permuted across shards by the rebalance collective without any id
+translation, and the batched solver gets one self-contained instance per
+lane. The cost is duplication of shared structure, which the per-node CSE
+in ``alloc`` (and small caps) keeps bounded.
+
+Argument encoding (``tape_a``/``tape_b``):
+  0   ARG_NONE — unused slot
+  -1  ARG_IMM  — the operand is a concrete 256-bit word stored in
+      ``tape_imm`` (at most one inline operand per node: two concrete
+      operands never allocate — the result would be concrete)
+  k>0          — reference to tape row k-1 of the same lane
+
+Node ops are a small QF_BV-at-256 subset plus EVM leaves. Comparison
+nodes (LT..ISZERO) are *word-valued* 0/1, matching how the EVM stacks
+them; the host bridge lifts them to If(cond, 1, 0) terms.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+ARG_NONE = 0
+ARG_IMM = -1
+
+# --- leaves -----------------------------------------------------------------
+OP_OPAQUE = 2  # host-only term carried through; imm[0] = host-side ref index
+OP_CDLOAD = 3  # 32-byte calldata read; a = offset (ref or ARG_IMM)
+OP_CDSIZE = 4
+OP_SLOAD = 5  # tx-initial storage read; a = key (ref or ARG_IMM)
+OP_CALLER = 6
+OP_CALLVALUE = 7
+OP_ORIGIN = 8
+OP_BALANCE = 9  # self-balance leaf
+# --- 256-bit ALU ------------------------------------------------------------
+OP_ADD = 10
+OP_SUB = 11
+OP_MUL = 12
+OP_UDIV = 13
+OP_SDIV = 14
+OP_UREM = 15
+OP_SREM = 16
+OP_EXP = 17
+OP_SIGNEXT = 18  # lhs = b (position), rhs = x (value), EVM operand order
+OP_AND = 19
+OP_OR = 20
+OP_XOR = 21
+OP_NOT = 22
+OP_BYTE = 23  # lhs = index, rhs = word
+OP_SHL = 24  # lhs = shift, rhs = value (EVM operand order)
+OP_SHR = 25
+OP_SAR = 26
+# --- word-valued (0/1) comparisons ------------------------------------------
+OP_LT = 27
+OP_GT = 28
+OP_SLT = 29
+OP_SGT = 30
+OP_EQ = 31
+OP_ISZERO = 32
+# --- keccak -----------------------------------------------------------------
+OP_COMB = 33  # one 32-byte word of a keccak preimage; a = word, b = rest chain
+OP_SHA3 = 34  # a = COMB chain; imm[0] = preimage byte length
+
+NDIGITS = 16
+
+# EVM opcode byte -> (tape op, arity); 0 = this opcode never allocates.
+SYM_OP = np.zeros(256, dtype=np.int32)
+SYM_ARITY = np.zeros(256, dtype=np.int32)
+for _byte, _top, _ar in [
+    (0x01, OP_ADD, 2), (0x02, OP_MUL, 2), (0x03, OP_SUB, 2),
+    (0x04, OP_UDIV, 2), (0x05, OP_SDIV, 2), (0x06, OP_UREM, 2),
+    (0x07, OP_SREM, 2), (0x0A, OP_EXP, 2), (0x0B, OP_SIGNEXT, 2),
+    (0x10, OP_LT, 2), (0x11, OP_GT, 2), (0x12, OP_SLT, 2),
+    (0x13, OP_SGT, 2), (0x14, OP_EQ, 2), (0x15, OP_ISZERO, 1),
+    (0x16, OP_AND, 2), (0x17, OP_OR, 2), (0x18, OP_XOR, 2),
+    (0x19, OP_NOT, 1), (0x1A, OP_BYTE, 2), (0x1B, OP_SHL, 2),
+    (0x1C, OP_SHR, 2), (0x1D, OP_SAR, 2),
+]:
+    SYM_OP[_byte] = _top
+    SYM_ARITY[_byte] = _ar
+
+
+def alloc(tapes, mask, op, a, b, imm):
+    """Append one node per masked lane, with per-lane CSE.
+
+    ``tapes`` is ``(tape_op, tape_a, tape_b, tape_imm, tape_len)``;
+    ``op/a/b`` are [L] i32, ``imm`` is [L, 16] u32. Returns
+    ``(tapes', id1, ok)`` where ``id1`` [L] is the 1-based node id (an
+    existing row if an identical node is already on the lane's tape) and
+    ``ok`` is False where the tape is full (caller traps the lane).
+    Lanes with ``mask`` False are untouched and get id1 = 0.
+    """
+    tape_op, tape_a, tape_b, tape_imm, tape_len = tapes
+    L, T = tape_op.shape
+    lane = jnp.arange(L)
+    slot = jnp.arange(T)[None, :]
+
+    live = slot < tape_len[:, None]
+    same = (
+        live
+        & (tape_op == op[:, None])
+        & (tape_a == a[:, None])
+        & (tape_b == b[:, None])
+        & jnp.all(tape_imm == imm[:, None, :], axis=-1)
+    )
+    hit = jnp.any(same, axis=-1)
+    hit_idx = jnp.argmax(same, axis=-1)
+
+    overflow = tape_len >= T
+    do_new = mask & ~hit & ~overflow
+    widx = jnp.clip(tape_len, 0, T - 1)
+
+    def put(plane, val):
+        return plane.at[lane, widx].set(
+            jnp.where(do_new, val, plane[lane, widx])
+        )
+
+    tape_op = put(tape_op, op)
+    tape_a = put(tape_a, a)
+    tape_b = put(tape_b, b)
+    tape_imm = tape_imm.at[lane, widx].set(
+        jnp.where(do_new[:, None], imm, tape_imm[lane, widx])
+    )
+    new_len = tape_len + do_new.astype(jnp.int32)
+
+    id1 = jnp.where(mask, jnp.where(hit, hit_idx, tape_len) + 1, 0)
+    ok = ~mask | hit | ~overflow
+    return (tape_op, tape_a, tape_b, tape_imm, new_len), id1.astype(jnp.int32), ok
