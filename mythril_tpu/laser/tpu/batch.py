@@ -80,7 +80,8 @@ class StateBatch(NamedTuple):
     sp: jnp.ndarray  # i32[L] number of live stack slots
     memory: jnp.ndarray  # u8[L, M]
     mem_words: jnp.ndarray  # i32[L] EVM msize / 32 (expansion high-water)
-    gas_left: jnp.ndarray  # u32[L]
+    gas_left: jnp.ndarray  # u32[L] gas remaining under the MIN-cost model
+    gas_spent_max: jnp.ndarray  # u32[L] accumulated MAX-cost bound
     storage_key: jnp.ndarray  # u32[L, K, 16]
     storage_val: jnp.ndarray  # u32[L, K, 16]
     storage_used: jnp.ndarray  # bool[L, K]
@@ -143,6 +144,7 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "memory": ((L, M), np.uint8),
         "mem_words": ((L,), np.int32),
         "gas_left": ((L,), np.uint32),
+        "gas_spent_max": ((L,), np.uint32),
         "storage_key": ((L, K, D), np.uint32),
         "storage_val": ((L, K, D), np.uint32),
         "storage_used": ((L, K), np.bool_),
@@ -288,6 +290,7 @@ def _fill_lane(
     np_batch["memory"][lane] = 0
     np_batch["mem_words"][lane] = 0
     np_batch["gas_left"][lane] = gas
+    np_batch["gas_spent_max"][lane] = 0
     np_batch["storage_used"][lane] = False
     np_batch["ret_off"][lane] = 0
     np_batch["ret_len"][lane] = 0

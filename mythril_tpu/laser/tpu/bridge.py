@@ -1,0 +1,648 @@
+"""Host <-> device state bridge: pack GlobalStates into lanes, lift tape
+nodes back into SMT terms, unpack lanes into resumable GlobalStates.
+
+This is the trap/resume protocol half the device engine promises
+(laser/tpu/batch.py): a lane that hits something outside the device model
+(CALL family, CREATE, symbolic memory offsets, ...) TRAPs frozen before
+the instruction; ``unpack_lane`` rebuilds an exact host ``GlobalState``
+(reference shape: mythril/laser/ethereum/state/global_state.py:21) and the
+host engine continues it through ``Instruction.evaluate``
+(mythril/laser/ethereum/instructions.py:1901-2407 for the call family).
+
+Lowering (host term -> tape rows) recognizes the seed state's environment
+leaves by hash-consed uid — calldata reads, calldatasize, sender, origin,
+callvalue, self-balance — so round-tripped states stay compact; anything
+with no device counterpart becomes an OPAQUE leaf carried by reference.
+Lifting rebuilds host terms through the smart constructors (hash-consing
+makes re-lifted leaves identical to the seed's originals) and returns
+keccak side-conditions the same way the host sha3_ op does
+(keccak_function_manager.create_keccak).
+
+States the bridge cannot represent raise ``PackError`` — the caller keeps
+them on the host path (the reference's concretize-or-bail idiom,
+mythril/laser/ethereum/util.py get_concrete_int, as a pressure valve).
+"""
+
+import logging
+from copy import copy
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mythril_tpu.laser.evm import util as evm_util
+from mythril_tpu.laser.evm.keccak_function_manager import keccak_function_manager
+from mythril_tpu.laser.evm.state.calldata import ConcreteCalldata
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.state.machine_state import MachineStack
+from mythril_tpu.laser.tpu import symtape, words
+from mythril_tpu.laser.tpu.batch import (
+    RUNNING,
+    BatchConfig,
+    CodeBank,
+    StateBatch,
+    append_node,
+    batch_shapes,
+    make_code_bank,
+    read_path,
+    read_storage_full,
+)
+from mythril_tpu.smt import (
+    BitVec,
+    Bool,
+    Concat,
+    If,
+    Not,
+    ULT,
+    symbol_factory,
+)
+from mythril_tpu.smt import terms
+
+log = logging.getLogger(__name__)
+
+
+class PackError(Exception):
+    """The state cannot be represented in the device model."""
+
+
+# host term op -> (device op, commutes-with-EVM-order)
+_TERM_TO_DEV = {
+    "add": symtape.OP_ADD,
+    "sub": symtape.OP_SUB,
+    "mul": symtape.OP_MUL,
+    "udiv": symtape.OP_UDIV,
+    "sdiv": symtape.OP_SDIV,
+    "urem": symtape.OP_UREM,
+    "srem": symtape.OP_SREM,
+    "and": symtape.OP_AND,
+    "or": symtape.OP_OR,
+    "xor": symtape.OP_XOR,
+}
+
+_CMP_TO_DEV = {
+    "ult": symtape.OP_LT,
+    "slt": symtape.OP_SLT,
+    "eq": symtape.OP_EQ,
+}
+
+
+def _word(value: int) -> np.ndarray:
+    return words.from_int(value)
+
+
+class DeviceBridge:
+    """Packs host states into a StateBatch and unpacks/lifts lanes back.
+
+    One bridge instance corresponds to one packed batch: ``seeds[i]`` is
+    the pristine host state that seeded lane ``seed_id == i`` (forked
+    children inherit the parent's seed id through the fork gather), and
+    ``opaque`` carries host terms referenced by OPAQUE leaves.
+    """
+
+    def __init__(self, cfg: BatchConfig):
+        self.cfg = cfg
+        self.seeds: List[GlobalState] = []
+        self.opaque: List[BitVec] = []
+        self._opaque_ids: Dict[int, int] = {}  # term uid -> opaque index
+        self.codes: List[bytes] = []
+        self._code_ids: Dict[bytes, int] = {}
+
+    # ------------------------------------------------------------------
+    # packing
+
+    def pack(self, states: List[GlobalState]) -> Tuple[CodeBank, StateBatch]:
+        """Pack host states into lanes [0..n); raises PackError whole-sale
+        only on config errors — per-state failures propagate so the caller
+        can keep that state on the host path."""
+        if len(states) > self.cfg.lanes:
+            raise PackError("more states than lanes")
+        np_batch = {
+            k: np.zeros(shape, dtype=dtype)
+            for k, (shape, dtype) in batch_shapes(self.cfg).items()
+        }
+        for i, state in enumerate(states):
+            self.pack_into(np_batch, i, state)
+        import jax.numpy as jnp
+
+        cb = make_code_bank(self.codes, self.cfg.code_len)
+        st = StateBatch(**{k: jnp.asarray(v) for k, v in np_batch.items()})
+        return cb, st
+
+    def pack_into(self, np_batch: dict, lane: int, state: GlobalState) -> None:
+        """Pack one host GlobalState into one lane of a numpy batch."""
+        env = state.environment
+        mstate = state.mstate
+        account = env.active_account
+        code_bytes = bytes.fromhex(env.code.bytecode)
+        if len(code_bytes) > self.cfg.code_len:
+            raise PackError("code exceeds bank width")
+        code_id = self._code_ids.get(code_bytes)
+        if code_id is None:
+            code_id = len(self.codes)
+            self.codes.append(code_bytes)
+            self._code_ids[code_bytes] = code_id
+
+        instr_list = env.code.instruction_list
+        if mstate.pc >= len(instr_list):
+            raise PackError("pc out of range")
+        pc_byte = instr_list[mstate.pc]["address"]
+
+        seed_id = len(self.seeds)
+        self.seeds.append(state)
+
+        L = np_batch["alive"].shape[0]
+        if lane >= L:
+            raise PackError("lane out of range")
+
+        np_batch["alive"][lane] = True
+        np_batch["status"][lane] = RUNNING
+        np_batch["pc"][lane] = pc_byte
+        np_batch["code_id"][lane] = code_id
+        np_batch["seed_id"][lane] = seed_id
+
+        gas_left = max(0, int(mstate.gas_limit) - int(mstate.min_gas_used))
+        np_batch["gas_left"][lane] = min(gas_left, 0xFFFFFFFF)
+
+        # --- environment leaves (recognized by hash-consed uid on lower)
+        leaf_map: Dict[int, Tuple[int, int, int, Optional[np.ndarray]]] = {}
+
+        def leaf(op, imm=None):
+            return (op, 0, 0, imm)
+
+        def reg_value(field_word, field_sym, term_w, dev_op):
+            if isinstance(term_w, int):
+                np_batch[field_word][lane] = _word(term_w)
+                return
+            if term_w.symbolic is False:
+                np_batch[field_word][lane] = _word(term_w.value)
+            else:
+                leaf_map[term_w.raw.uid] = leaf(dev_op)
+                np_batch[field_sym][lane] = append_node(np_batch, lane, dev_op)
+
+        reg_value("caller", "caller_sym", env.sender, symtape.OP_CALLER)
+        reg_value("origin", "origin_sym", env.origin, symtape.OP_ORIGIN)
+        reg_value("callvalue", "callvalue_sym", env.callvalue, symtape.OP_CALLVALUE)
+
+        if isinstance(env.address, BitVec):
+            if env.address.symbolic:
+                raise PackError("symbolic self address")
+            np_batch["address"][lane] = _word(env.address.value)
+        else:
+            np_batch["address"][lane] = _word(int(env.address))
+
+        balance = account.balance() if callable(account.balance) else account.balance
+        reg_value("balance", "balance_sym", balance, symtape.OP_BALANCE)
+
+        # --- calldata
+        calldata = env.calldata
+        if isinstance(calldata, ConcreteCalldata):
+            data = bytes(calldata.concrete(None))
+            if len(data) > self.cfg.calldata_bytes:
+                raise PackError("calldata exceeds capacity")
+            np_batch["calldata"][lane, : len(data)] = np.frombuffer(data, np.uint8)
+            np_batch["calldata_len"][lane] = len(data)
+        else:
+            np_batch["calldata_symbolic"][lane] = True
+            size_t = calldata.calldatasize
+            leaf_map[size_t.raw.uid] = leaf(symtape.OP_CDSIZE)
+            np_batch["cdsize_sym"][lane] = append_node(
+                np_batch, lane, symtape.OP_CDSIZE
+            )
+            # pre-register word reads at 32-byte offsets so round-tripped
+            # stack values lower back to CDLOAD leaves
+            for k in range(self.cfg.calldata_bytes // 32):
+                t = calldata.get_word_at(k * 32)
+                if isinstance(t, BitVec) and t.symbolic:
+                    leaf_map[t.raw.uid] = leaf(
+                        symtape.OP_CDLOAD, _word(k * 32)
+                    )
+
+        self._leaf_maps = getattr(self, "_leaf_maps", {})
+        self._leaf_maps[seed_id] = leaf_map
+
+        # --- stack
+        if len(mstate.stack) > self.cfg.stack_slots:
+            raise PackError("stack exceeds capacity")
+        for i, item in enumerate(mstate.stack):
+            if isinstance(item, int):
+                np_batch["stack"][lane, i] = _word(item)
+            elif item.symbolic is False:
+                np_batch["stack"][lane, i] = _word(item.value)
+            else:
+                np_batch["stack_sym"][lane, i] = self._lower(
+                    np_batch, lane, leaf_map, item.raw
+                )
+        np_batch["sp"][lane] = len(mstate.stack)
+
+        # --- memory (concrete bytes + aligned 32-byte symbolic words)
+        msize = len(mstate.memory)
+        if msize > self.cfg.memory_bytes:
+            raise PackError("memory exceeds capacity")
+        np_batch["mem_words"][lane] = (msize + 31) // 32
+        sym_words: Dict[int, terms.Term] = {}
+        for off in range(msize):
+            cell = mstate.memory[off]
+            if isinstance(cell, int):
+                np_batch["memory"][lane, off] = cell & 0xFF
+            elif cell.symbolic is False:
+                np_batch["memory"][lane, off] = cell.value & 0xFF
+            else:
+                raw = cell.raw
+                # write_word_at writes Extract((31-rel)*8+7, (31-rel)*8, w)
+                rel = off % 32
+                base = off - rel
+                if (
+                    raw.op == "extract"
+                    and raw.params[0] == (31 - rel) * 8 + 7
+                    and raw.params[1] == (31 - rel) * 8
+                ):
+                    prev = sym_words.get(base)
+                    if prev is None:
+                        sym_words[base] = raw.args[0]
+                    elif prev is not raw.args[0]:
+                        raise PackError("interleaved symbolic memory words")
+                else:
+                    raise PackError("unaligned symbolic memory byte")
+        # each symbolic word must cover its full 32 bytes
+        slot = 0
+        for base, t in sorted(sym_words.items()):
+            for j in range(32):
+                cell = mstate.memory[base + j]
+                if isinstance(cell, int) or cell.symbolic is False:
+                    raise PackError("partially-symbolic memory word")
+            if slot >= self.cfg.mem_sym_slots:
+                raise PackError("too many symbolic memory words")
+            np_batch["msym_off"][lane, slot] = base
+            np_batch["msym_id"][lane, slot] = self._lower(
+                np_batch, lane, leaf_map, t
+            )
+            np_batch["msym_used"][lane, slot] = True
+            slot += 1
+
+        # --- storage
+        storage = account.storage
+        concrete_world = not storage._standard_storage.__class__.__name__ == "Array"
+        np_batch["storage_symbolic"][lane] = not concrete_world
+        entries = list(storage.printable_storage.items())
+        if len(entries) > self.cfg.storage_slots:
+            raise PackError("storage exceeds slot capacity")
+        for j, (k_bv, v_bv) in enumerate(entries):
+            if k_bv.symbolic:
+                np_batch["skey_sym"][lane, j] = self._lower(
+                    np_batch, lane, leaf_map, k_bv.raw
+                )
+            else:
+                np_batch["storage_key"][lane, j] = _word(k_bv.value)
+            if isinstance(v_bv, int):
+                np_batch["storage_val"][lane, j] = _word(v_bv)
+            elif v_bv.symbolic:
+                np_batch["sval_sym"][lane, j] = self._lower(
+                    np_batch, lane, leaf_map, v_bv.raw
+                )
+            else:
+                np_batch["storage_val"][lane, j] = _word(v_bv.value)
+            np_batch["storage_used"][lane, j] = True
+
+    # ------------------------------------------------------------------
+    # term lowering (host -> tape)
+
+    def _opaque(self, np_batch, lane, raw: terms.Term) -> int:
+        idx = self._opaque_ids.get(raw.uid)
+        if idx is None:
+            idx = len(self.opaque)
+            self.opaque.append(raw)
+            self._opaque_ids[raw.uid] = idx
+        return append_node(
+            np_batch, lane, symtape.OP_OPAQUE, imm=_word(idx)
+        )
+
+    def _lower(self, np_batch, lane, leaf_map, raw: terms.Term, _memo=None) -> int:
+        """Lower a host term into the lane's tape; returns 1-based id."""
+        if _memo is None:
+            _memo = {}
+        if raw.uid in _memo:
+            return _memo[raw.uid]
+
+        def rec(t):
+            return self._lower(np_batch, lane, leaf_map, t, _memo)
+
+        node_id = None
+        hit = leaf_map.get(raw.uid)
+        if hit is not None:
+            op, na, nb, imm = hit
+            node_id = append_node(np_batch, lane, op, na, nb, imm)
+        elif raw.op == "const":
+            # a bare const should have stayed on the concrete plane; as a
+            # node arg it rides inline — parent handles it
+            raise PackError("const reached _lower")
+        elif raw.op in _TERM_TO_DEV and len(raw.args) == 2:
+            node_id = self._lower_binop(
+                np_batch, lane, _TERM_TO_DEV[raw.op], raw.args, rec
+            )
+        elif raw.op == "not" and raw.sort == terms.BV:
+            node_id = append_node(
+                np_batch, lane, symtape.OP_NOT, rec(raw.args[0]), 0
+            )
+        elif raw.op == "shl":
+            # terms.bv_shl(value, shift); device lhs=shift, rhs=value
+            node_id = self._lower_shift(np_batch, lane, symtape.OP_SHL, raw, rec)
+        elif raw.op == "lshr":
+            node_id = self._lower_shift(np_batch, lane, symtape.OP_SHR, raw, rec)
+        elif raw.op == "ashr":
+            node_id = self._lower_shift(np_batch, lane, symtape.OP_SAR, raw, rec)
+        elif raw.op == "ite":
+            node_id = self._lower_ite(np_batch, lane, raw, rec)
+        elif raw.op == "apply" and str(raw.params[0]).startswith("keccak256_"):
+            node_id = self._lower_keccak(np_batch, lane, raw, rec)
+        if node_id is None:
+            node_id = self._opaque(np_batch, lane, raw)
+        _memo[raw.uid] = node_id
+        return node_id
+
+    def _arg(self, np_batch, lane, t: terms.Term, rec):
+        """(arg encoding, imm or None) for one operand."""
+        if t.op == "const":
+            return symtape.ARG_IMM, _word(t.value)
+        return rec(t), None
+
+    def _lower_binop(self, np_batch, lane, dev_op, args, rec):
+        ea, imma = self._arg(np_batch, lane, args[0], rec)
+        eb, immb = self._arg(np_batch, lane, args[1], rec)
+        if imma is not None and immb is not None:
+            raise PackError("two-const binop reached _lower")
+        imm = imma if imma is not None else immb
+        return append_node(np_batch, lane, dev_op, ea, eb, imm)
+
+    def _lower_shift(self, np_batch, lane, dev_op, raw, rec):
+        # host (value, shift) -> device (lhs=shift, rhs=value)
+        ev, immv = self._arg(np_batch, lane, raw.args[0], rec)
+        es, imms = self._arg(np_batch, lane, raw.args[1], rec)
+        if immv is not None and imms is not None:
+            raise PackError("two-const shift reached _lower")
+        imm = imms if imms is not None else immv
+        return append_node(np_batch, lane, dev_op, es, ev, imm)
+
+    def _lower_ite(self, np_batch, lane, raw, rec):
+        cond, tv, fv = raw.args
+        if not (
+            tv.op == "const" and tv.value == 1 and fv.op == "const" and fv.value == 0
+        ):
+            return None
+        if cond.op in _CMP_TO_DEV and len(cond.args) == 2:
+            return self._lower_binop(
+                np_batch, lane, _CMP_TO_DEV[cond.op], cond.args, rec
+            )
+        return None
+
+    def _lower_keccak(self, np_batch, lane, raw, rec):
+        data = raw.args[0]
+        if data.size == 256:
+            word_terms = [data]
+        elif data.op == "concat" and all(t.size == 256 for t in data.args):
+            word_terms = list(data.args)
+        else:
+            return None
+        if len(word_terms) > 4:
+            return None
+        rest = 0
+        for t in reversed(word_terms):
+            ea, imm = self._arg(np_batch, lane, t, rec)
+            rest = append_node(np_batch, lane, symtape.OP_COMB, ea, rest, imm)
+        return append_node(
+            np_batch,
+            lane,
+            symtape.OP_SHA3,
+            rest,
+            0,
+            _word(32 * len(word_terms)),
+        )
+
+    # ------------------------------------------------------------------
+    # term lifting (tape -> host)
+
+    def lift_lane(self, st: StateBatch, lane: int):
+        """Lift every tape node of a lane; returns (values, side_conds).
+
+        values[i] is the host BitVec for 1-based id i+1; side_conds are
+        keccak consistency Bools to append to the path condition.
+        """
+        seed = self.seeds[int(np.asarray(st.seed_id)[lane])]
+        env = seed.environment
+        account = env.active_account
+        n = int(np.asarray(st.tape_len)[lane])
+        ops = np.asarray(st.tape_op)[lane]
+        aa = np.asarray(st.tape_a)[lane]
+        bb = np.asarray(st.tape_b)[lane]
+        imms = np.asarray(st.tape_imm)[lane]
+        values: List[Optional[BitVec]] = [None] * n
+        side: List[Bool] = []
+
+        def arg(i, enc):
+            if enc == symtape.ARG_IMM:
+                return symbol_factory.BitVecVal(words.to_int(imms[i]), 256)
+            if enc > 0:
+                return values[enc - 1]
+            return None
+
+        one = symbol_factory.BitVecVal(1, 256)
+        zero = symbol_factory.BitVecVal(0, 256)
+
+        for i in range(n):
+            op = int(ops[i])
+            x = arg(i, int(aa[i]))
+            y = arg(i, int(bb[i]))
+            imm_int = words.to_int(imms[i])
+            if op == symtape.OP_OPAQUE:
+                v = BitVec(self.opaque[imm_int])
+            elif op == symtape.OP_CDLOAD:
+                off = x if int(aa[i]) > 0 else imm_int
+                off = off.value if isinstance(off, BitVec) and not off.symbolic else off
+                v = env.calldata.get_word_at(off)
+            elif op == symtape.OP_CDSIZE:
+                v = env.calldata.calldatasize
+            elif op == symtape.OP_CALLER:
+                v = env.sender
+            elif op == symtape.OP_ORIGIN:
+                v = env.origin
+            elif op == symtape.OP_CALLVALUE:
+                v = env.callvalue
+            elif op == symtape.OP_BALANCE:
+                bal = account.balance() if callable(account.balance) else account.balance
+                v = bal
+            elif op == symtape.OP_SLOAD:
+                key = x if int(aa[i]) > 0 else symbol_factory.BitVecVal(imm_int, 256)
+                v = account.storage[key]
+            elif op == symtape.OP_SHA3:
+                data_words = []
+                j = int(aa[i])
+                while j > 0:
+                    k = j - 1
+                    w = arg(k, int(aa[k]))
+                    data_words.append(
+                        w
+                        if w is not None
+                        else symbol_factory.BitVecVal(words.to_int(imms[k]), 256)
+                    )
+                    j = int(bb[k])
+                data = (
+                    data_words[0]
+                    if len(data_words) == 1
+                    else Concat(data_words)
+                )
+                v, cond = keccak_function_manager.create_keccak(data)
+                side.append(cond)
+            elif op == symtape.OP_COMB:
+                v = zero  # never read directly; SHA3 walks the chain
+            elif op == symtape.OP_ADD:
+                v = x + y
+            elif op == symtape.OP_SUB:
+                v = x - y
+            elif op == symtape.OP_MUL:
+                v = x * y
+            elif op == symtape.OP_UDIV:
+                from mythril_tpu.smt import UDiv
+
+                v = If(y == 0, zero, UDiv(x, y))
+            elif op == symtape.OP_SDIV:
+                v = If(y == 0, zero, x / y)
+            elif op == symtape.OP_UREM:
+                from mythril_tpu.smt import URem
+
+                v = If(y == 0, zero, URem(x, y))
+            elif op == symtape.OP_SREM:
+                from mythril_tpu.smt import SRem
+
+                v = If(y == 0, zero, SRem(x, y))
+            elif op == symtape.OP_EXP:
+                # device EXP nodes are rare; carry an uninterpreted leaf
+                v = symbol_factory.BitVecSym(f"devexp_{lane}_{i}", 256)
+            elif op == symtape.OP_SIGNEXT:
+                v = symbol_factory.BitVecSym(f"devsignext_{lane}_{i}", 256)
+            elif op == symtape.OP_AND:
+                v = x & y
+            elif op == symtape.OP_OR:
+                v = x | y
+            elif op == symtape.OP_XOR:
+                v = x ^ y
+            elif op == symtape.OP_NOT:
+                v = ~x
+            elif op == symtape.OP_BYTE:
+                v = symbol_factory.BitVecSym(f"devbyte_{lane}_{i}", 256)
+            elif op == symtape.OP_SHL:
+                v = y << x
+            elif op == symtape.OP_SHR:
+                from mythril_tpu.smt import LShR
+
+                v = LShR(y, x)
+            elif op == symtape.OP_SAR:
+                v = y >> x
+            elif op == symtape.OP_LT:
+                v = If(ULT(x, y), one, zero)
+            elif op == symtape.OP_GT:
+                v = If(ULT(y, x), one, zero)
+            elif op == symtape.OP_SLT:
+                v = If(x < y, one, zero)
+            elif op == symtape.OP_SGT:
+                v = If(y < x, one, zero)
+            elif op == symtape.OP_EQ:
+                v = If(x == y, one, zero)
+            elif op == symtape.OP_ISZERO:
+                v = If(x == zero, one, zero)
+            else:
+                raise ValueError(f"unknown tape op {op}")
+            values[i] = v
+        return values, side
+
+    # ------------------------------------------------------------------
+    # unpacking
+
+    def lane_constraints(self, st: StateBatch, lane: int, values, side):
+        """The lane's accumulated path condition as host Bools."""
+        conds: List[Bool] = list(side)
+        for node_id, sign in read_path(st, lane):
+            w = values[node_id - 1]
+            zero = symbol_factory.BitVecVal(0, 256)
+            conds.append(Not(w == zero) if sign else (w == zero))
+        return conds
+
+    def unpack_lane(self, st: StateBatch, lane: int) -> GlobalState:
+        """Rebuild a host GlobalState from a lane (frozen at its pc)."""
+        seed = self.seeds[int(np.asarray(st.seed_id)[lane])]
+        gs = copy(seed)
+        values, side = self.lift_lane(st, lane)
+
+        instr_list = gs.environment.code.instruction_list
+        pc_byte = int(np.asarray(st.pc)[lane])
+        pc_index = evm_util.get_instruction_index(instr_list, pc_byte)
+        if pc_index is None:
+            pc_index = len(instr_list)
+        gs.mstate.pc = pc_index
+
+        # stack
+        sp = int(np.asarray(st.sp)[lane])
+        stack_words = np.asarray(st.stack)[lane]
+        stack_tags = np.asarray(st.stack_sym)[lane]
+        new_stack = MachineStack()
+        for i in range(sp):
+            tag = int(stack_tags[i])
+            if tag > 0:
+                new_stack.append(values[tag - 1])
+            else:
+                new_stack.append(
+                    symbol_factory.BitVecVal(words.to_int(stack_words[i]), 256)
+                )
+        gs.mstate.stack = new_stack
+
+        # memory: concrete bytes, then symbolic overlay words
+        mem_words_n = int(np.asarray(st.mem_words)[lane])
+        msize = mem_words_n * 32
+        cur = len(gs.mstate.memory)
+        if msize > cur:
+            gs.mstate.memory.extend(msize - cur)
+        mem_bytes = np.asarray(st.memory)[lane]
+        for off in range(min(msize, mem_bytes.shape[0])):
+            gs.mstate.memory[off] = int(mem_bytes[off])
+        used = np.asarray(st.msym_used)[lane]
+        offs = np.asarray(st.msym_off)[lane]
+        ids = np.asarray(st.msym_id)[lane]
+        for j in range(used.shape[0]):
+            if used[j]:
+                gs.mstate.memory.write_word_at(int(offs[j]), values[int(ids[j]) - 1])
+
+        # storage: apply store-written entries (skip load-created caches)
+        account = gs.environment.active_account
+        tape_ops = np.asarray(st.tape_op)[lane]
+        tape_a = np.asarray(st.tape_a)[lane]
+        tape_imm = np.asarray(st.tape_imm)[lane]
+        for key_int, val_int, ktag, vtag in read_storage_full(st, lane):
+            if vtag > 0 and int(tape_ops[vtag - 1]) == symtape.OP_SLOAD:
+                leaf_a = int(tape_a[vtag - 1])
+                if leaf_a == symtape.ARG_IMM and ktag == 0 and (
+                    words.to_int(tape_imm[vtag - 1]) == key_int
+                ):
+                    continue  # load-created: Select(storage, k) cached at k
+                if leaf_a > 0 and leaf_a == ktag:
+                    continue
+            key = (
+                values[ktag - 1]
+                if ktag > 0
+                else symbol_factory.BitVecVal(key_int, 256)
+            )
+            val = (
+                values[vtag - 1]
+                if vtag > 0
+                else symbol_factory.BitVecVal(val_int, 256)
+            )
+            account.storage[key] = val
+
+        # gas accounting: gas_left tracks the MIN-cost model; the separate
+        # gas_spent_max counter accumulates the worst-case bound (symbolic
+        # EXP exponents, symbolic SSTORE old/new values, ...)
+        packed_gas = max(0, int(seed.mstate.gas_limit) - int(seed.mstate.min_gas_used))
+        spent = max(0, min(packed_gas, 0xFFFFFFFF) - int(np.asarray(st.gas_left)[lane]))
+        gs.mstate.min_gas_used += spent
+        gs.mstate.max_gas_used += int(np.asarray(st.gas_spent_max)[lane])
+
+        # path conditions + keccak side conditions
+        for cond in self.lane_constraints(st, lane, values, side):
+            gs.world_state.constraints.append(cond)
+        return gs

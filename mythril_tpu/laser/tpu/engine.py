@@ -66,13 +66,16 @@ SHA_SYM_WORDS = 4  # max 32-byte words in a symbolic keccak preimage
 _POPS = np.zeros(256, dtype=np.int32)
 _PUSHES = np.zeros(256, dtype=np.int32)
 _GAS = np.zeros(256, dtype=np.uint32)
+_GAS_MAX = np.zeros(256, dtype=np.uint32)
 _KNOWN = np.zeros(256, dtype=bool)
 for _b, _spec in OPCODES.items():
     _KNOWN[_b] = True
     _POPS[_b] = _spec.pops
     _PUSHES[_b] = _spec.pushes
     _GAS[_b] = _spec.min_gas
+    _GAS_MAX[_b] = _spec.max_gas
 _GAS[0x55] = 0  # SSTORE gas is fully dynamic (computed in step)
+_GAS_MAX[0x55] = 0
 
 # Ops the device kernel does not model: lane traps, host resumes.
 # (BALANCE 0x31 is absent: self-address reads answer on device, and the
@@ -126,6 +129,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     pops = jnp.asarray(_POPS)[op]
     pushes = jnp.asarray(_PUSHES)[op]
     static_gas = jnp.asarray(_GAS)[op]
+    static_gas_max = jnp.asarray(_GAS_MAX)[op]
     is_invalid = jnp.asarray(_INVALID)[op]
     is_trap_op = jnp.asarray(_TRAP_TABLE)[op]
 
@@ -476,6 +480,23 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         (st.skey_sym == 0) & jnp.all(st.storage_key == a[:, None, :], axis=-1),
     )  # [L, K]
     found = jnp.any(key_match, axis=-1)
+    # Aliasing guard: the syntactic-match model is justified by keccak
+    # output disjointness ONLY between hash images and small slot indices.
+    # A concrete key >= 2^128 is (almost certainly) a keccak image — e.g.
+    # a slot concretized in a prior tx — and CAN alias a symbolic keccak
+    # probe (or vice versa), so a probe that misses in that situation
+    # leaves the device model instead of silently answering.
+    entry_big_conc = st.storage_used & (st.skey_sym == 0) & jnp.any(
+        st.storage_key[:, :, 8:] != 0, axis=-1
+    )
+    any_big_conc = jnp.any(entry_big_conc, axis=-1)
+    any_sym_entry = jnp.any(st.storage_used & (st.skey_sym > 0), axis=-1)
+    probe_big_conc = ~has_a & jnp.any(a[:, 8:] != 0, axis=-1)
+    storage_alias_trap = (
+        (is_sload | is_sstore)
+        & ~found
+        & ((has_a & any_big_conc) | (probe_big_conc & any_sym_entry))
+    )
     sel_slot = jnp.argmax(key_match, axis=-1)
     loaded = jnp.where(
         found[:, None], st.storage_val[lane, sel_slot], jnp.zeros_like(a)
@@ -486,7 +507,12 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # SLOAD miss on a symbolic world: materialize a Select(storage, key)
     # leaf and cache it in the associative store so repeated loads agree
     sload_leaf_mask = (
-        ok_lane & is_sload & ~found & st.storage_symbolic & key_sha3_ok
+        ok_lane
+        & is_sload
+        & ~found
+        & st.storage_symbolic
+        & key_sha3_ok
+        & ~storage_alias_trap
     )
     skey_node_a = jnp.where(has_a, sym_a, symtape.ARG_IMM)
     skey_imm = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
@@ -504,15 +530,18 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     first_free = jnp.argmin(st.storage_used, axis=-1)
     store_slot = jnp.where(found, sel_slot, first_free)
     need_insert = (is_sstore | sload_leaf_mask) & ~found
-    storage_trap = need_insert & all_used
+    storage_trap = (need_insert & all_used) | storage_alias_trap
     do_store = ok_lane & (is_sstore | sload_leaf_mask) & ~storage_trap & ~sym_key_trap
     # symbolic values zero the concrete plane (sval_sym is authoritative),
     # so host readers can never mistake a placeholder word for a write
     write_val = jnp.where((is_sstore & ~has_b)[:, None], b, jnp.zeros_like(b))
     write_val_sym = jnp.where(is_sstore, sym_b, sload_leaf_id)
     write_key_sym = jnp.where(has_a, sym_a, 0)
+    # symbolic keys zero the concrete plane (skey_sym is authoritative),
+    # matching write_val's zeroed-placeholder contract
+    write_key = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
     new_storage_key = st.storage_key.at[lane, store_slot].set(
-        jnp.where(do_store[:, None], a, st.storage_key[lane, store_slot])
+        jnp.where(do_store[:, None], write_key, st.storage_key[lane, store_slot])
     )
     new_storage_val = st.storage_val.at[lane, store_slot].set(
         jnp.where(do_store[:, None], write_val, st.storage_val[lane, store_slot])
@@ -738,6 +767,24 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     new_gas = jnp.where(
         charged & ~oog, st.gas_left - total_gas, jnp.where(oog, U32(0), st.gas_left)
     )
+    # the MAX-cost bound: where a symbolic operand hid the true dynamic
+    # cost from the min counter, accumulate the worst case instead
+    gas_exp_max = jnp.where(is_exp, jnp.where(has_b, U32(50 * 32), 50 * exp_bytes), 0)
+    sstore_gas_max = jnp.where(
+        is_sstore,
+        jnp.where(
+            fresh_nonzero | (loaded_sym > 0) | (sym_b > 0) | (st.storage_symbolic & ~found),
+            U32(20000),
+            U32(5000),
+        ),
+        U32(0),
+    )
+    total_gas_max = (
+        static_gas_max + gas_mem + gas_exp_max + gas_sha + gas_copy + gas_log + sstore_gas_max
+    )
+    new_gas_max = jnp.where(
+        charged & ~oog, st.gas_spent_max + total_gas_max, st.gas_spent_max
+    )
 
     new_status = jnp.where(
         hard_err | oog,
@@ -864,6 +911,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         memory=merge(mem, st.memory),
         mem_words=merge(new_mem_words, st.mem_words),
         gas_left=merge(new_gas, st.gas_left, status_mask),
+        gas_spent_max=merge(new_gas_max, st.gas_spent_max, status_mask),
         storage_key=merge(new_storage_key, st.storage_key),
         storage_val=merge(new_storage_val, st.storage_val),
         storage_used=merge(new_storage_used, st.storage_used),

@@ -70,8 +70,6 @@ OP_ISZERO = 32
 OP_COMB = 33  # one 32-byte word of a keccak preimage; a = word, b = rest chain
 OP_SHA3 = 34  # a = COMB chain; imm[0] = preimage byte length
 
-NDIGITS = 16
-
 # EVM opcode byte -> (tape op, arity); 0 = this opcode never allocates.
 SYM_OP = np.zeros(256, dtype=np.int32)
 SYM_ARITY = np.zeros(256, dtype=np.int32)

@@ -1,0 +1,266 @@
+"""Device<->host bridge: pack, run, lift, unpack, and trap-resume.
+
+Parity targets: the reference's per-state fork copy + constraints
+(mythril/laser/ethereum/state/global_state.py:63) and the call family the
+device can't model (mythril/laser/ethereum/instructions.py:1901-2407) —
+a trapped lane must resume through the host engine and complete.
+"""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.evm.state.calldata import ConcreteCalldata, SymbolicCalldata
+from mythril_tpu.laser.evm.svm import LaserEVM
+from mythril_tpu.laser.evm.strategy.basic import BreadthFirstSearchStrategy
+from mythril_tpu.laser.evm.transaction.transaction_models import (
+    MessageCallTransaction,
+    get_next_transaction_id,
+)
+from mythril_tpu.laser.tpu import symtape
+from mythril_tpu.laser.tpu.batch import (
+    BatchConfig,
+    RUNNING,
+    STOPPED,
+    TRAP,
+    default_env,
+    read_storage_full,
+)
+from mythril_tpu.laser.tpu.bridge import DeviceBridge
+from mythril_tpu.laser.tpu.engine import run
+from mythril_tpu.smt import symbol_factory
+
+
+def deploy(runtime_src: str):
+    """Deploy runtime code through a real creation tx; returns laser + account."""
+    runtime = assemble(runtime_src).hex()
+    n = len(runtime) // 2
+    creation = (
+        assemble(
+            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+            "PUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + runtime
+    )
+    laser = LaserEVM(
+        strategy=BreadthFirstSearchStrategy,
+        transaction_count=1,
+        execution_timeout=60,
+        max_depth=128,
+    )
+    laser.sym_exec(creation_code=creation, contract_name="T")
+    ws = laser.open_states[0]
+    (address,) = ws._accounts.keys()
+    return laser, ws, ws[symbol_factory.BitVecVal(address, 256)]
+
+
+def message_state(ws, account, calldata=None):
+    """Initial GlobalState of a message call (symbolic calldata default)."""
+    from mythril_tpu.laser.evm.cfg import Node
+
+    tx_id = get_next_transaction_id()
+    sender = symbol_factory.BitVecSym(f"sender_{tx_id}", 256)
+    tx = MessageCallTransaction(
+        world_state=ws,
+        identifier=tx_id,
+        gas_price=symbol_factory.BitVecVal(10, 256),
+        gas_limit=8_000_000,
+        origin=sender,
+        caller=sender,
+        callee_account=account,
+        call_data=(
+            SymbolicCalldata(tx_id)
+            if calldata is None
+            else ConcreteCalldata(tx_id, list(calldata))
+        ),
+        call_value=symbol_factory.BitVecSym(f"call_value{tx_id}", 256),
+    )
+    gs = tx.initial_global_state()
+    gs.transaction_stack.append((tx, None))
+    node = Node(gs.environment.active_account.contract_name)
+    node.constraints = gs.world_state.constraints
+    gs.world_state.transaction_sequence.append(tx)
+    gs.node = node
+    node.states.append(gs)
+    return gs
+
+
+CFG = BatchConfig(
+    lanes=8,
+    stack_slots=16,
+    memory_bytes=256,
+    calldata_bytes=128,
+    storage_slots=8,
+    code_len=256,
+    tape_slots=64,
+    path_slots=16,
+    mem_sym_slots=8,
+)
+
+
+BRANCH_STORE_SRC = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH2 :x
+JUMPI
+STOP
+x:
+JUMPDEST
+PUSH1 0x04
+CALLDATALOAD
+PUSH1 0x00
+SSTORE
+STOP
+"""
+
+
+def test_pack_run_unpack_roundtrip():
+    laser, ws, account = deploy(BRANCH_STORE_SRC)
+    gs = message_state(ws, account)
+    n_constraints0 = len(gs.world_state.constraints)
+
+    bridge = DeviceBridge(CFG)
+    cb, st = bridge.pack([gs])
+    out = run(cb, default_env(), st, max_steps=128)
+
+    alive = np.asarray(out.alive)
+    status = np.asarray(out.status)
+    assert alive.sum() == 2
+    assert (status[:2] == STOPPED).all()
+
+    # fall-through lane: CDLOAD(0) == 0 constraint, no storage writes
+    gs0 = bridge.unpack_lane(out, 0)
+    assert len(gs0.world_state.constraints) == n_constraints0 + 1
+    assert gs0.world_state.constraints.is_possible
+
+    # taken lane: CDLOAD(0) != 0, storage[0] = CDLOAD(4) (symbolic)
+    gs1 = bridge.unpack_lane(out, 1)
+    assert gs1.world_state.constraints.is_possible
+    storage = gs1.environment.active_account.storage
+    key = symbol_factory.BitVecVal(0, 256)
+    val = storage[key]
+    assert val.symbolic
+    # the lifted value is exactly the calldata word-read term
+    expected = gs1.environment.calldata.get_word_at(4)
+    assert val.raw is expected.raw
+
+    # pc is past the code (STOP halted the lane)
+    assert gs0.mstate.pc >= 0 and gs1.mstate.pc >= 0
+
+
+def test_unpack_preserves_fall_through_vs_taken_constraints():
+    laser, ws, account = deploy(BRANCH_STORE_SRC)
+    gs = message_state(ws, account)
+    bridge = DeviceBridge(CFG)
+    cb, st = bridge.pack([gs])
+    out = run(cb, default_env(), st, max_steps=128)
+
+    gs0 = bridge.unpack_lane(out, 0)
+    gs1 = bridge.unpack_lane(out, 1)
+    c0 = gs0.world_state.constraints[-1]
+    c1 = gs1.world_state.constraints[-1]
+    # the two lanes carry complementary conditions over the same read
+    assert c0.raw is not c1.raw
+    from mythril_tpu.smt import And
+
+    assert not And(c0, c1).value  # not trivially true
+    # both individually satisfiable, their conjunction is UNSAT
+    from mythril_tpu.smt import Solver
+
+    s = Solver()
+    s.add(And(c0, c1))
+    assert s.check().name.lower() == "unsat"
+
+
+CALL_SRC = """
+PUSH32 0x00112233445566778899aabbccddeeff00112233445566778899aabbccddeeff
+PUSH1 0x00
+MSTORE
+PUSH1 0x20
+PUSH1 0x40
+PUSH1 0x20
+PUSH1 0x00
+PUSH1 0x00
+PUSH1 0x04
+PUSH2 0xffff
+CALL
+POP
+PUSH1 0x40
+MLOAD
+PUSH1 0x01
+SSTORE
+STOP
+"""
+
+
+def test_call_trap_resumes_through_host_engine():
+    """VERDICT round-1 item 3: a CALL-trapping contract completes
+    end-to-end through device+host; the call (identity precompile 0x4)
+    must actually execute."""
+    laser, ws, account = deploy(CALL_SRC)
+    gs = message_state(ws, account, calldata=b"")
+    bridge = DeviceBridge(CFG)
+    cb, st = bridge.pack([gs])
+    out = run(cb, default_env(), st, max_steps=128)
+
+    status = np.asarray(out.status)
+    assert status[0] == TRAP
+    assert int(np.asarray(out.trap_op)[0]) == 0xF1  # CALL
+
+    resumed = bridge.unpack_lane(out, 0)
+    # frozen before the CALL: 7 call args on the stack
+    assert len(resumed.mstate.stack) == 7
+    assert resumed.get_current_instruction()["opcode"] == "CALL"
+
+    # hand the lane back to the host engine and let it finish the tx
+    laser.open_states = []
+    laser.work_list.append(resumed)
+    laser.exec()
+    assert len(laser.open_states) == 1
+    storage = laser.open_states[0][account.address].storage
+    val = storage[symbol_factory.BitVecVal(1, 256)]
+    # the identity precompile copied the memory word; SSTORE(1) saw it
+    assert not val.symbolic
+    assert val.value == 0x00112233445566778899AABBCCDDEEFF00112233445566778899AABBCCDDEEFF
+
+
+def test_trapped_symbolic_state_resumes_with_constraints():
+    # symbolic branch first, then a CALL on the taken side: the resumed
+    # state must carry the branch constraint through the host engine
+    src = """
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH2 :x
+    JUMPI
+    STOP
+    x:
+    JUMPDEST
+    PUSH1 0x20
+    PUSH1 0x40
+    PUSH1 0x20
+    PUSH1 0x00
+    PUSH1 0x00
+    PUSH1 0x04
+    PUSH2 0xffff
+    CALL
+    STOP
+    """
+    laser, ws, account = deploy(src)
+    gs = message_state(ws, account)
+    bridge = DeviceBridge(CFG)
+    cb, st = bridge.pack([gs])
+    out = run(cb, default_env(), st, max_steps=128)
+    status = np.asarray(out.status)
+    alive = np.asarray(out.alive)
+    assert alive.sum() == 2
+    trap_lane = int(np.argmax(status == TRAP))
+    assert int(np.asarray(out.trap_op)[trap_lane]) == 0xF1
+
+    resumed = bridge.unpack_lane(out, trap_lane)
+    assert resumed.world_state.constraints.is_possible
+    laser.open_states = []
+    laser.work_list.append(resumed)
+    laser.exec()
+    assert len(laser.open_states) == 1
+    # the surviving world state still carries the branch condition
+    assert laser.open_states[0].constraints.is_possible
