@@ -71,9 +71,12 @@ class SymExecWrapper:
         elif strategy == "weighted-random":
             s_strategy = ReturnWeightedRandomStrategy
         elif strategy == "tpu-batch":
-            # the batched engine reuses BFS ordering on the host side; the
-            # batch scheduler lives in mythril_tpu/laser/tpu/engine.py
-            s_strategy = BreadthFirstSearchStrategy
+            # the hybrid host/device backend (laser/tpu/backend.py):
+            # LaserEVM.exec delegates the message-call rounds to the
+            # batched device engine behind this strategy marker
+            from mythril_tpu.laser.tpu.backend import TpuBatchStrategy
+
+            s_strategy = TpuBatchStrategy
         else:
             raise ValueError("Invalid strategy argument supplied")
 

@@ -1,0 +1,216 @@
+"""The tpu-batch execution backend: a hybrid host/device work loop.
+
+This is the integration seam the reference leaves at the strategy
+boundary (mythril/laser/ethereum/strategy/__init__.py:6 iterator protocol
++ plugins/plugin.py:4 hooks): selecting ``--strategy tpu-batch`` replaces
+the one-state-at-a-time host loop (svm.py:220 exec) with alternating
+phases over the whole frontier:
+
+  phase A (host): every state in the work list executes exactly ONE
+    instruction through ``LaserEVM.execute_state`` — pre/post hooks fire,
+    detection modules see the state, Transaction signals and VM
+    exceptions are handled with full fidelity, and infeasible successors
+    are filtered — the same per-instruction semantics as the reference's
+    hot loop.
+  phase B (device): the surviving frontier packs into a SoA StateBatch
+    (laser/tpu/bridge.py) and the batched step kernel advances every lane
+    in lockstep — forking on unhooked symbolic JUMPIs — until each lane
+    freezes at the next host-relevant instruction: a hooked opcode, the
+    call family, a halt (STOP/RETURN/REVERT/SELFDESTRUCT), or an error
+    condition (replayed on host so exception handling and world-state
+    revert semantics stay exact). Unpacked lanes rejoin the work list.
+
+Opcodes with registered hooks always return to the host, so detection
+modules observe every state they would have seen in the reference
+pipeline. States the bridge cannot represent (PackError) simply stay on
+the host path — the loop degrades gracefully to pure host execution.
+"""
+
+import logging
+from datetime import datetime, timedelta
+from typing import List, Optional
+
+import numpy as np
+
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.strategy import BasicSearchStrategy
+from mythril_tpu.laser.tpu.batch import (
+    BatchConfig,
+    RUNNING,
+    default_env,
+)
+from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
+from mythril_tpu.laser.tpu.engine import run
+from mythril_tpu.support.opcodes import OPCODES
+
+log = logging.getLogger(__name__)
+
+# ops that end a transaction or leave the device model — always host-side
+_ALWAYS_HOST = (
+    "STOP",
+    "RETURN",
+    "REVERT",
+    "SUICIDE",
+    "ASSERT_FAIL",
+    "INVALID",
+    # block-context ops push SYMBOLIC values on the host (environment.py
+    # block_number/chainid); the device only has concrete placeholders
+    "TIMESTAMP",
+    "NUMBER",
+    "DIFFICULTY",
+    "COINBASE",
+    "GASLIMIT",
+    "CHAINID",
+    "BASEFEE",
+    "BLOCKHASH",
+    "GASPRICE",
+)
+
+_NAME_TO_BYTE = {spec.name: byte for byte, spec in OPCODES.items()}
+
+
+# module-level default so tests/CLI can swap in a differently-sized batch
+# before SymExecWrapper constructs the strategy
+DEFAULT_BATCH_CFG = BatchConfig(
+    lanes=256,
+    stack_slots=32,
+    memory_bytes=1024,
+    calldata_bytes=256,
+    storage_slots=16,
+    code_len=8192,
+    tape_slots=192,
+    path_slots=32,
+    mem_sym_slots=8,
+)
+
+
+class TpuBatchStrategy(BasicSearchStrategy):
+    """Marker strategy selecting the batched device backend.
+
+    Iterating it behaves as BFS — used for the creation transaction and
+    as the fallback when the device path is unavailable. Batch sizing is
+    carried here so SymExecWrapper/CLI flags have a place to put it.
+    """
+
+    def __init__(self, work_list, max_depth, batch_cfg: Optional[BatchConfig] = None):
+        super().__init__(work_list, max_depth)
+        self.batch_cfg = batch_cfg or DEFAULT_BATCH_CFG
+        self.device_rounds = 0
+        self.device_steps_retired = 0
+
+    def get_strategic_global_state(self) -> GlobalState:
+        return self.work_list.pop(0)
+
+
+def find_tpu_strategy(strategy) -> Optional[TpuBatchStrategy]:
+    """Unwrap decorator strategies (BoundedLoops/Coverage) to the marker."""
+    seen = set()
+    while strategy is not None and id(strategy) not in seen:
+        seen.add(id(strategy))
+        if isinstance(strategy, TpuBatchStrategy):
+            return strategy
+        strategy = getattr(strategy, "super_strategy", None)
+    return None
+
+
+def host_op_bytes(laser) -> set:
+    """Opcode bytes that must freeze-trap back to the host loop."""
+    hooked = set()
+    for name, hooks in list(laser.pre_hooks.items()) + list(laser.post_hooks.items()):
+        if not hooks:
+            continue
+        base = name
+        byte = _NAME_TO_BYTE.get(base)
+        if byte is not None:
+            hooked.add(byte)
+        # hook names like LOG0..LOG4 / PUSH1.. resolve individually; a
+        # wildcard registration hooks everything
+        if base == "*":
+            return set(range(256))
+    for name in _ALWAYS_HOST:
+        byte = _NAME_TO_BYTE.get(name)
+        if byte is not None:
+            hooked.add(byte)
+    return hooked
+
+
+def exec_batch(laser, track_gas=False) -> None:
+    """Drain the work list through alternating host/device phases."""
+    strategy = find_tpu_strategy(laser.strategy)
+    cfg = strategy.batch_cfg
+    host_ops = host_op_bytes(laser)
+    seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
+
+    while laser.work_list:
+        if (
+            laser.execution_timeout
+            and laser.time + timedelta(seconds=laser.execution_timeout)
+            <= datetime.now()
+        ):
+            log.debug("Hit execution timeout in tpu-batch loop, returning.")
+            return
+
+        # ---------------- phase A: one host instruction per state
+        pending = laser.work_list[:]
+        del laser.work_list[:]
+        survivors: List[GlobalState] = []
+        for global_state in pending:
+            if global_state.mstate.depth >= laser.max_depth:
+                continue
+            try:
+                new_states, op_code = laser.execute_state(global_state)
+            except NotImplementedError:
+                log.debug("Encountered unimplemented instruction")
+                continue
+            new_states = [
+                state
+                for state in new_states
+                if state.world_state.constraints.is_possible
+            ]
+            laser.manage_cfg(op_code, new_states)
+            survivors.extend(new_states)
+            laser.total_states += len(new_states)
+        if not survivors:
+            continue
+
+        # ---------------- phase B: batched device rounds
+        to_pack = survivors[:seed_cap]
+        overflow = survivors[seed_cap:]
+        laser.work_list.extend(overflow)
+
+        bridge = DeviceBridge(cfg, host_ops=host_ops, freeze_errors=True)
+        packed_states = []
+        for state in to_pack:
+            try:
+                bridge.stage(state)
+                packed_states.append(state)
+            except PackError as e:
+                log.debug("State stays on host path: %s", e)
+                laser.work_list.append(state)
+        if not packed_states:
+            continue
+
+        cb, st = bridge.finish()
+        out = run(cb, default_env(), st, max_steps=4096)
+        strategy.device_rounds += 1
+        strategy.device_steps_retired += int(np.asarray(out.steps).sum())
+
+        alive = np.asarray(out.alive)
+        status = np.asarray(out.status)
+        for lane in range(cfg.lanes):
+            if not alive[lane]:
+                continue
+            if status[lane] == RUNNING:
+                # step budget exhausted mid-flight: unpack and continue on
+                # whatever path the next iteration chooses
+                pass
+            try:
+                resumed = bridge.unpack_lane(out, lane)
+            except Exception as e:  # pragma: no cover - lift bugs surface here
+                log.warning("unpack failed for lane %d: %s", lane, e)
+                continue
+            if not resumed.world_state.constraints.is_possible:
+                continue
+            laser.work_list.append(resumed)
+        # device-born forks add to the explored-state count
+        laser.total_states += max(0, int(alive.sum()) - len(packed_states))

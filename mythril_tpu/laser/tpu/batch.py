@@ -49,11 +49,20 @@ class BatchConfig(NamedTuple):
 
 
 class CodeBank(NamedTuple):
-    """Deduplicated bytecode plane shared by all lanes (lane -> code_id)."""
+    """Deduplicated bytecode plane shared by all lanes (lane -> code_id).
+
+    ``host_ops`` and ``freeze_errors`` configure the hybrid host/device
+    loop (laser/tpu/backend.py): opcodes flagged in host_ops freeze-trap
+    so the host executes them with full hook/signal fidelity, and with
+    freeze_errors set, error conditions (invalid op, stack faults, bad
+    jumps, OOG) freeze instead of killing the lane so the host replays
+    them through its exception handling."""
 
     code: jnp.ndarray  # u8[n_codes, code_len]
     code_len: jnp.ndarray  # i32[n_codes]
     jumpdest: jnp.ndarray  # bool[n_codes, code_len] valid JUMPDEST targets
+    host_ops: jnp.ndarray  # bool[256] opcodes that must return to the host
+    freeze_errors: jnp.ndarray  # bool[] scalar
 
 
 class Env(NamedTuple):
@@ -192,8 +201,11 @@ def empty_batch(cfg: BatchConfig) -> StateBatch:
     )
 
 
-def make_code_bank(codes, code_len: int) -> CodeBank:
-    """Host helper: list of bytes objects -> CodeBank (pads / analyses)."""
+def make_code_bank(codes, code_len: int, host_ops=None, freeze_errors=False) -> CodeBank:
+    """Host helper: list of bytes objects -> CodeBank (pads / analyses).
+
+    ``host_ops`` is an optional iterable of opcode bytes that must
+    freeze-trap back to the host (hybrid-loop mode)."""
     n = len(codes)
     code = np.zeros((n, code_len), dtype=np.uint8)
     lens = np.zeros((n,), dtype=np.int32)
@@ -212,7 +224,16 @@ def make_code_bank(codes, code_len: int) -> CodeBank:
             if 0x60 <= op <= 0x7F:
                 pc += op - 0x5F
             pc += 1
-    return CodeBank(jnp.asarray(code), jnp.asarray(lens), jnp.asarray(jd))
+    hops = np.zeros(256, dtype=bool)
+    for b in host_ops or ():
+        hops[b] = True
+    return CodeBank(
+        jnp.asarray(code),
+        jnp.asarray(lens),
+        jnp.asarray(jd),
+        jnp.asarray(hops),
+        jnp.asarray(bool(freeze_errors)),
+    )
 
 
 def default_env() -> Env:

@@ -98,34 +98,73 @@ class DeviceBridge:
     ``opaque`` carries host terms referenced by OPAQUE leaves.
     """
 
-    def __init__(self, cfg: BatchConfig):
+    def __init__(self, cfg: BatchConfig, host_ops=None, freeze_errors: bool = False):
         self.cfg = cfg
+        self.host_ops = host_ops
+        self.freeze_errors = freeze_errors
         self.seeds: List[GlobalState] = []
         self.opaque: List[BitVec] = []
         self._opaque_ids: Dict[int, int] = {}  # term uid -> opaque index
         self.codes: List[bytes] = []
         self._code_ids: Dict[bytes, int] = {}
+        self._np_batch: Optional[dict] = None
+        self._n_staged = 0
+        # (seed_id, node_id) -> wrapper annotations recorded at pack time.
+        # Forked children share the parent's tape prefix, so pack-time ids
+        # are stable across descendants; device-born combinations inherit
+        # annotations for free because lifting uses the annotation-union
+        # wrapper ops (smt/bitvec_helper.py), same as the reference's
+        # taint mechanism (mythril/laser/smt/expression.py annotations).
+        self.pack_annotations: Dict[Tuple[int, int], set] = {}
 
     # ------------------------------------------------------------------
     # packing
 
-    def pack(self, states: List[GlobalState]) -> Tuple[CodeBank, StateBatch]:
-        """Pack host states into lanes [0..n); raises PackError whole-sale
-        only on config errors — per-state failures propagate so the caller
-        can keep that state on the host path."""
-        if len(states) > self.cfg.lanes:
-            raise PackError("more states than lanes")
-        np_batch = {
-            k: np.zeros(shape, dtype=dtype)
-            for k, (shape, dtype) in batch_shapes(self.cfg).items()
-        }
-        for i, state in enumerate(states):
-            self.pack_into(np_batch, i, state)
+    def stage(self, state: GlobalState) -> int:
+        """Pack one host state into the next lane; returns the lane.
+
+        On PackError the lane is wiped and the bridge stays consistent —
+        the caller keeps that state on the host path.
+        """
+        if self._np_batch is None:
+            self._np_batch = {
+                k: np.zeros(shape, dtype=dtype)
+                for k, (shape, dtype) in batch_shapes(self.cfg).items()
+            }
+        lane = self._n_staged
+        if lane >= self.cfg.lanes:
+            raise PackError("batch full")
+        n_seeds = len(self.seeds)
+        try:
+            self.pack_into(self._np_batch, lane, state)
+        except PackError:
+            del self.seeds[n_seeds:]
+            for plane in self._np_batch.values():
+                plane[lane] = 0
+            raise
+        self._n_staged += 1
+        return lane
+
+    def finish(self) -> Tuple[CodeBank, StateBatch]:
+        """Freeze the staged lanes into device arrays."""
         import jax.numpy as jnp
 
-        cb = make_code_bank(self.codes, self.cfg.code_len)
-        st = StateBatch(**{k: jnp.asarray(v) for k, v in np_batch.items()})
+        if self._np_batch is None or self._n_staged == 0:
+            raise PackError("nothing staged")
+        cb = make_code_bank(
+            self.codes,
+            self.cfg.code_len,
+            host_ops=self.host_ops,
+            freeze_errors=self.freeze_errors,
+        )
+        st = StateBatch(**{k: jnp.asarray(v) for k, v in self._np_batch.items()})
         return cb, st
+
+    def pack(self, states: List[GlobalState]) -> Tuple[CodeBank, StateBatch]:
+        """Stage + finish in one call (per-state PackErrors propagate)."""
+        for state in states:
+            self.stage(state)
+        return self.finish()
 
     def pack_into(self, np_batch: dict, lane: int, state: GlobalState) -> None:
         """Pack one host GlobalState into one lane of a numpy batch."""
@@ -219,6 +258,16 @@ class DeviceBridge:
         self._leaf_maps = getattr(self, "_leaf_maps", {})
         self._leaf_maps[seed_id] = leaf_map
 
+        def lower_top(wrapper):
+            """Lower a top-level wrapper, preserving its annotations."""
+            node_id = self._lower(np_batch, lane, leaf_map, wrapper.raw)
+            if wrapper.annotations:
+                key = (seed_id, node_id)
+                self.pack_annotations.setdefault(key, set()).update(
+                    wrapper.annotations
+                )
+            return node_id
+
         # --- stack
         if len(mstate.stack) > self.cfg.stack_slots:
             raise PackError("stack exceeds capacity")
@@ -228,9 +277,7 @@ class DeviceBridge:
             elif item.symbolic is False:
                 np_batch["stack"][lane, i] = _word(item.value)
             else:
-                np_batch["stack_sym"][lane, i] = self._lower(
-                    np_batch, lane, leaf_map, item.raw
-                )
+                np_batch["stack_sym"][lane, i] = lower_top(item)
         np_batch["sp"][lane] = len(mstate.stack)
 
         # --- memory (concrete bytes + aligned 32-byte symbolic words)
@@ -277,6 +324,17 @@ class DeviceBridge:
             )
             np_batch["msym_used"][lane, slot] = True
             slot += 1
+        # re-attach annotations the byte-wise Extract cells carried
+        for base in sym_words:
+            cell = mstate.memory[base]
+            if isinstance(cell, BitVec) and cell.annotations:
+                key = (seed_id, int(np_batch["msym_id"][lane, 0]))
+                # find the slot for this base
+                for j in range(slot):
+                    if int(np_batch["msym_off"][lane, j]) == base:
+                        key = (seed_id, int(np_batch["msym_id"][lane, j]))
+                        break
+                self.pack_annotations.setdefault(key, set()).update(cell.annotations)
 
         # --- storage
         storage = account.storage
@@ -287,17 +345,13 @@ class DeviceBridge:
             raise PackError("storage exceeds slot capacity")
         for j, (k_bv, v_bv) in enumerate(entries):
             if k_bv.symbolic:
-                np_batch["skey_sym"][lane, j] = self._lower(
-                    np_batch, lane, leaf_map, k_bv.raw
-                )
+                np_batch["skey_sym"][lane, j] = lower_top(k_bv)
             else:
                 np_batch["storage_key"][lane, j] = _word(k_bv.value)
             if isinstance(v_bv, int):
                 np_batch["storage_val"][lane, j] = _word(v_bv)
             elif v_bv.symbolic:
-                np_batch["sval_sym"][lane, j] = self._lower(
-                    np_batch, lane, leaf_map, v_bv.raw
-                )
+                np_batch["sval_sym"][lane, j] = lower_top(v_bv)
             else:
                 np_batch["storage_val"][lane, j] = _word(v_bv.value)
             np_batch["storage_used"][lane, j] = True
@@ -425,7 +479,8 @@ class DeviceBridge:
         values[i] is the host BitVec for 1-based id i+1; side_conds are
         keccak consistency Bools to append to the path condition.
         """
-        seed = self.seeds[int(np.asarray(st.seed_id)[lane])]
+        seed_id_val = int(np.asarray(st.seed_id)[lane])
+        seed = self.seeds[seed_id_val]
         env = seed.environment
         account = env.active_account
         n = int(np.asarray(st.tape_len)[lane])
@@ -549,6 +604,11 @@ class DeviceBridge:
                 v = If(x == zero, one, zero)
             else:
                 raise ValueError(f"unknown tape op {op}")
+            # re-attach pack-time annotations (taint) without mutating
+            # shared leaf wrappers
+            ann = self.pack_annotations.get((seed_id_val, i + 1))
+            if ann and isinstance(v, BitVec):
+                v = BitVec(v.raw, annotations=set(v.annotations) | ann)
             values[i] = v
         return values, side
 

@@ -749,23 +749,34 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         | path_full_trap
         | fork_no_slot
     )
+    is_host_op = cb.host_ops[op]
+    freeze = cb.freeze_errors  # hybrid-loop mode: errors freeze for host replay
+    err_cond = is_invalid | underflow | evm_overflow | jump_err
     trap = (
-        is_trap_op
-        | balance_trap
-        | mem_cap_trap
-        | retcopy_trap
-        | storage_trap
-        | sha_trap
-        | sym_trap
-        | (model_overflow & ~evm_overflow)
-    ) & ~is_invalid & ~underflow
-    hard_err = is_invalid | underflow | evm_overflow | jump_err
+        (
+            is_trap_op
+            | balance_trap
+            | mem_cap_trap
+            | retcopy_trap
+            | storage_trap
+            | sha_trap
+            | sym_trap
+            | is_host_op
+            | (model_overflow & ~evm_overflow)
+        )
+        & ~is_invalid
+        & ~underflow
+    ) | (freeze & err_cond)
+    hard_err = err_cond & ~freeze & ~trap
 
     total_gas = static_gas + gas_mem + gas_exp + gas_sha + gas_copy + gas_log + sstore_gas
     charged = ~trap & ~hard_err
     oog = charged & (st.gas_left < total_gas)
+    frozen_oog = freeze & oog
     new_gas = jnp.where(
-        charged & ~oog, st.gas_left - total_gas, jnp.where(oog, U32(0), st.gas_left)
+        charged & ~oog,
+        st.gas_left - total_gas,
+        jnp.where(oog & ~freeze, U32(0), st.gas_left),
     )
     # the MAX-cost bound: where a symbolic operand hid the true dynamic
     # cost from the min counter, accumulate the worst case instead
@@ -787,10 +798,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
 
     new_status = jnp.where(
-        hard_err | oog,
+        hard_err | (oog & ~freeze),
         ERROR,
         jnp.where(
-            trap,
+            trap | frozen_oog,
             TRAP,
             jnp.where(
                 is_stop,
@@ -903,7 +914,9 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     nst = StateBatch(
         alive=st.alive,
         status=merge(new_status, st.status, status_mask),
-        trap_op=merge(jnp.where(trap, op, st.trap_op), st.trap_op, status_mask),
+        trap_op=merge(
+            jnp.where(trap | frozen_oog, op, st.trap_op), st.trap_op, status_mask
+        ),
         pc=merge(new_pc, st.pc),
         code_id=st.code_id,
         stack=merge(stack_after, st.stack),
