@@ -523,12 +523,37 @@ int tsat_new_var(void* s) { return ((tsat::Solver*)s)->new_var(); }
 void tsat_add_clause(void* s, const int* lits, int n) {
   ((tsat::Solver*)s)->add_clause(lits, n);
 }
+// Bulk interface: `flat` holds clauses separated by 0 sentinels. One ctypes
+// crossing per batch instead of one per clause (the per-call marshalling cost
+// dominated bit-blasting before this existed).
+void tsat_add_clauses(void* s, const int* flat, int n) {
+  auto* solver = (tsat::Solver*)s;
+  const int* start = flat;
+  for (int i = 0; i < n; i++) {
+    if (flat[i] == 0) {
+      solver->add_clause(start, (int)(flat + i - start));
+      start = flat + i + 1;
+    }
+  }
+}
+void tsat_ensure_vars(void* s, int n) {
+  auto* solver = (tsat::Solver*)s;
+  while (solver->nvars < n) solver->new_var();
+}
 int tsat_solve(void* s, const int* assumptions, int n, int timeout_ms,
                long long conflict_budget) {
   return ((tsat::Solver*)s)->solve(assumptions, n, timeout_ms, conflict_budget);
 }
 int tsat_model_value(void* s, int var) {
   return ((tsat::Solver*)s)->model_value(var);
+}
+// Copy the whole assignment (1/-1/0 per var) in one crossing: out[v-1] holds
+// var v's value. Model extraction over many variables was one ctypes call
+// per bit before this existed.
+void tsat_model_copy(void* s, signed char* out, int n) {
+  auto* solver = (tsat::Solver*)s;
+  int limit = n < solver->nvars ? n : solver->nvars;
+  for (int v = 1; v <= limit; v++) out[v - 1] = (signed char)solver->assign[v - 1];
 }
 int tsat_ok(void* s) { return ((tsat::Solver*)s)->ok ? 1 : 0; }
 }

@@ -36,6 +36,12 @@ class Constraints(list):
         self._is_possible = solver.check() is not unsat
         return self._is_possible
 
+    def seed_feasibility(self, value: bool) -> None:
+        """Install an externally computed feasibility verdict (the batched
+        device solver decides whole frontiers at once; see
+        laser/tpu/solver_jax.py). Only sound results may be seeded."""
+        self._is_possible = value
+
     def append(self, constraint: Union[bool, Bool]) -> None:
         constraint = (
             constraint if isinstance(constraint, Bool) else symbol_factory.Bool(constraint)

@@ -41,6 +41,7 @@ from mythril_tpu.laser.tpu.batch import (
 )
 from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
 from mythril_tpu.laser.tpu.engine import run
+from mythril_tpu.laser.tpu import solver_jax
 from mythril_tpu.support.opcodes import OPCODES
 
 log = logging.getLogger(__name__)
@@ -134,6 +135,40 @@ def host_op_bytes(laser) -> set:
     return hooked
 
 
+# frontiers below this size are cheaper on the warm host CDCL than through
+# a device dispatch; above it, one batched call decides every path condition
+MIN_DEVICE_SOLVE_BATCH = 4
+
+
+def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
+    """Frontier-wide feasibility: decide every undecided path condition in
+    one batched device solve (unit propagation + ordered-DPLL search,
+    laser/tpu/solver_jax.py), seed the sound verdicts, and let the host
+    incremental CDCL pick up only the instances the device left open.
+
+    Replaces the reference's one-Z3-call-per-forked-state pattern
+    (mythril/laser/ethereum/svm.py:254, state/constraints.py:41)."""
+    undecided = [
+        s for s in states if s.world_state.constraints._is_possible is None
+    ]
+    if len(undecided) >= MIN_DEVICE_SOLVE_BATCH:
+        sets = [
+            [c.raw for c in s.world_state.constraints] for s in undecided
+        ]
+        try:
+            # modest search budget: this is triage — propagation decides the
+            # common selector/guard conditions instantly, and anything the
+            # budget leaves open goes to the warm host CDCL
+            verdicts = solver_jax.feasibility_batch(sets, flips=384)
+        except Exception as e:  # pragma: no cover - device issues degrade
+            log.warning("device feasibility batch failed: %s", e)
+            verdicts = [None] * len(undecided)
+        for s, verdict in zip(undecided, verdicts):
+            if verdict is not None:
+                s.world_state.constraints.seed_feasibility(verdict)
+    return [s for s in states if s.world_state.constraints.is_possible]
+
+
 def exec_batch(laser, track_gas=False) -> None:
     """Drain the work list through alternating host/device phases."""
     strategy = find_tpu_strategy(laser.strategy)
@@ -153,7 +188,7 @@ def exec_batch(laser, track_gas=False) -> None:
         # ---------------- phase A: one host instruction per state
         pending = laser.work_list[:]
         del laser.work_list[:]
-        survivors: List[GlobalState] = []
+        produced: List[tuple] = []  # (new_states, op_code) per executed state
         for global_state in pending:
             if global_state.mstate.depth >= laser.max_depth:
                 continue
@@ -162,6 +197,11 @@ def exec_batch(laser, track_gas=False) -> None:
             except NotImplementedError:
                 log.debug("Encountered unimplemented instruction")
                 continue
+            produced.append((new_states, op_code))
+        # feasibility for the whole successor frontier in one device call
+        filter_feasible([s for states, _ in produced for s in states])
+        survivors = []
+        for new_states, op_code in produced:
             new_states = [
                 state
                 for state in new_states
@@ -197,6 +237,7 @@ def exec_batch(laser, track_gas=False) -> None:
 
         alive = np.asarray(out.alive)
         status = np.asarray(out.status)
+        resumed_states = []
         for lane in range(cfg.lanes):
             if not alive[lane]:
                 continue
@@ -209,8 +250,7 @@ def exec_batch(laser, track_gas=False) -> None:
             except Exception as e:  # pragma: no cover - lift bugs surface here
                 log.warning("unpack failed for lane %d: %s", lane, e)
                 continue
-            if not resumed.world_state.constraints.is_possible:
-                continue
-            laser.work_list.append(resumed)
+            resumed_states.append(resumed)
+        laser.work_list.extend(filter_feasible(resumed_states))
         # device-born forks add to the explored-state count
         laser.total_states += max(0, int(alive.sum()) - len(packed_states))

@@ -1,0 +1,382 @@
+"""Batched bit-blasted tensor solver: frontier-wide feasibility on device.
+
+This is the SURVEY §2.1 ★ core target. The reference runs one Z3 check per
+forked state (mythril/laser/ethereum/state/constraints.py:41, called from
+svm.py:254); here the whole frontier's path conditions are bit-blasted to
+CNF instances (sharing the Blaster gate layer with the host exact solver,
+smt/solver/bitblast.py), padded into tensors, and decided in ONE device
+call:
+
+  phase 1 — batched boolean constraint propagation: three-valued unit
+    propagation to fixpoint across all instances in lockstep. A conflict
+    is a sound UNSAT proof (no decisions were made); all-clauses-satisfied
+    is a sound SAT witness. EVM path conditions are dominated by
+    equality-with-constant conjuncts (function selectors, jump guards), so
+    propagation alone settles most instances.
+  phase 2 — multi-restart WalkSAT on whatever propagation left open:
+    random parallel restarts per instance, flipping variables of random
+    unsatisfied clauses. Any all-clauses-satisfied assignment is a sound
+    SAT witness (the CNF is Tseitin-equisatisfiable with the formula).
+
+Instances that stay open after the flip budget return UNKNOWN and fall
+back to the host incremental CDCL core (smt/solver/incremental.py). Hard
+instances (wide multipliers, deep store chains) are rejected during
+compilation by gate-count caps *before* any expensive blasting happens —
+the early-abort keeps per-instance compile cost in the milliseconds.
+
+Everything here is static-shaped for XLA: instance tensors are padded to
+power-of-two buckets (vars/clauses/batch) so recompiles are rare; the
+search itself is lax.while_loop'd scalar-free vector work that maps onto
+the VPU. Clause width is fixed at 3 (the Blaster's gate layer emits only
+1..3-literal clauses), so the clause matrix is [I, C, 3] int32 in HBM.
+"""
+
+import logging
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.solver import pysat
+from mythril_tpu.smt.solver.bitblast import Blaster, BlastError
+from mythril_tpu.smt.solver.preprocess import eliminate_theories
+from mythril_tpu.smt.terms import Term
+
+log = logging.getLogger(__name__)
+
+SAT = pysat.SAT
+UNSAT = pysat.UNSAT
+UNKNOWN = pysat.UNKNOWN
+
+# compile-time caps: instances larger than this go to the host CDCL instead.
+# Batches are always padded to exactly (MAX_VARS, MAX_CLAUSES) — canonical
+# shapes mean ONE kernel compile per batch-size bucket for the process
+# lifetime (first XLA compile is tens of seconds; recompiling per frontier
+# shape would burn the analysis time budget). Tests shrink these knobs.
+MAX_VARS = 4096
+MAX_CLAUSES = 1 << 14
+MAX_BATCH = 64  # larger frontiers are chunked
+
+_jax = None
+_jnp = None
+
+
+def _ensure_jax():
+    global _jax, _jnp
+    if _jax is None:
+        import jax
+        import jax.numpy as jnp
+
+        _jax, _jnp = jax, jnp
+    return _jax, _jnp
+
+
+class CapExceeded(Exception):
+    """Instance outgrew the device caps during blasting (early abort)."""
+
+
+class _CappedRecorder:
+    """PySat-shaped sink that records CNF instead of solving, aborting as
+    soon as the instance exceeds the device size caps."""
+
+    __slots__ = ("nvars", "clauses", "max_vars", "max_clauses")
+
+    def __init__(self, max_vars: int = MAX_VARS, max_clauses: int = MAX_CLAUSES):
+        self.nvars = 0
+        self.clauses: List[Tuple[int, ...]] = []
+        self.max_vars = max_vars
+        self.max_clauses = max_clauses
+
+    def new_var(self) -> int:
+        self.nvars += 1
+        if self.nvars > self.max_vars:
+            raise CapExceeded("vars")
+        return self.nvars
+
+    def add_clause(self, lits) -> None:
+        self.clauses.append(tuple(lits))
+        if len(self.clauses) > self.max_clauses:
+            raise CapExceeded("clauses")
+
+
+class CNFInstance:
+    """One compiled path condition."""
+
+    __slots__ = ("clause_arr", "nvars", "inputs", "trivial")
+
+    def __init__(self, clauses, nvars, inputs=(), trivial: Optional[int] = None):
+        # pre-packed [n, 3] literal matrix: _pack_batch slice-assigns it
+        # instead of looping Python-side per literal on the frontier path
+        arr = np.zeros((len(clauses), 3), dtype=np.int32)
+        for ci, cl in enumerate(clauses):
+            arr[ci, : len(cl)] = cl
+        self.clause_arr = arr
+        self.nvars = nvars
+        self.inputs = inputs  # SAT vars of the formula's free symbols
+        self.trivial = trivial  # SAT/UNSAT decided at compile time, or None
+
+
+def compile_cnf(
+    assertions: Sequence[Term],
+    max_vars: int = MAX_VARS,
+    max_clauses: int = MAX_CLAUSES,
+) -> Optional[CNFInstance]:
+    """Blast one constraint set to a CNF instance; None if it exceeds the
+    device caps or contains un-blastable structure."""
+    if any(t is terms.FALSE for t in assertions):
+        return CNFInstance([], 0, trivial=UNSAT)
+    concrete = [t for t in assertions if t is not terms.TRUE]
+    if not concrete:
+        return CNFInstance([], 0, trivial=SAT)
+    rec = _CappedRecorder(max_vars, max_clauses)
+    blaster = Blaster(rec)
+    try:
+        rewritten, _info = eliminate_theories(list(concrete))
+        for t in rewritten:
+            blaster.assert_formula(t)
+    except (CapExceeded, BlastError):
+        return None
+    inputs = []
+    for bits in blaster.var_bits.values():
+        inputs.extend(abs(b) for b in bits)
+    for lit in blaster.bool_vars.values():
+        inputs.append(abs(lit))
+    return CNFInstance(rec.clauses, rec.nvars, tuple(inputs))
+
+
+def _pow2(n: int, lo: int = 16) -> int:
+    v = lo
+    while v < n:
+        v <<= 1
+    return v
+
+
+def _pack_batch(instances: List[CNFInstance], pad_vars: int, pad_clauses: int):
+    """Pad live instances into canonical [I, C, 3] clause tensors."""
+    C = pad_clauses
+    V = pad_vars
+    I = _pow2(len(instances), lo=1)
+    lits = np.zeros((I, C, 3), dtype=np.int32)
+    nvars = np.zeros((I,), dtype=np.int32)
+    is_input = np.zeros((I, V), dtype=bool)
+    for k, inst in enumerate(instances):
+        nvars[k] = inst.nvars
+        if inst.inputs:
+            is_input[k, np.asarray(inst.inputs, dtype=np.int64) - 1] = True
+        lits[k, : inst.clause_arr.shape[0]] = inst.clause_arr
+    return lits, nvars, is_input, V
+
+
+def _solve_kernel(lits, key, nvars, is_input, pad_vars: int, flips: int):
+    """lits: [I, C, 3] int32 (0-padded); key: PRNG key; nvars: [I] real var
+    counts (decisions never touch padding vars); is_input: [I, V] mask of
+    the formula's free-symbol bits — decided first so the Tseitin circuit
+    evaluates by propagation instead of conflicting on random gate guesses.
+
+    Returns (status[I], assign[I, V])."""
+    jax, jnp = _ensure_jax()
+    lax = jax.lax
+    I, C, _ = lits.shape
+    V = pad_vars
+
+    var = jnp.abs(lits) - 1  # [I,C,3]; -1 for padding
+    vidx = jnp.clip(var, 0, V - 1)
+    sign = lits > 0
+    real = lits != 0  # literal exists
+    real_clause = real.any(-1)  # [I,C]
+    iidx = jnp.arange(I)[:, None, None]
+
+    def lit_values(val):
+        v = val[iidx, vidx]  # [I,C,3]
+        return jnp.where(real, jnp.where(sign, v, -v), 0)
+
+    # ---- phase 1: three-valued unit propagation ----
+    def prop_body(state):
+        val, changed, conflict = state
+        lit_val = lit_values(val)
+        c_sat = (lit_val == 1).any(-1)
+        n_unknown = ((lit_val == 0) & real).sum(-1)
+        dead = real_clause & ~c_sat & (n_unknown == 0)
+        new_conflict = dead.any(-1)  # [I]
+        unit = real_clause & ~c_sat & (n_unknown == 1)  # [I,C]
+        # index of the unknown literal in each unit clause
+        unk_pos = jnp.argmax((lit_val == 0) & real, axis=-1)  # [I,C]
+        u_lit = jnp.take_along_axis(lits, unk_pos[..., None], axis=-1)[..., 0]
+        u_var = jnp.clip(jnp.abs(u_lit) - 1, 0, V - 1)
+        u_val = jnp.where(u_lit > 0, 1, -1).astype(jnp.int8)
+        # scatter forced values (sentinel -2 = no force); if two clauses force
+        # opposite values in one pass, max() picks one and the loser's clause
+        # turns into a conflict next round
+        upd = jnp.full((I, V), -2, dtype=jnp.int8)
+        upd = upd.at[jnp.arange(I)[:, None], u_var].max(
+            jnp.where(unit, u_val, jnp.int8(-2)), mode="drop"
+        )
+        force = upd > jnp.int8(-2)
+        new_val = jnp.where((val == 0) & force, upd, val)
+        new_changed = (new_val != val).any()
+        return new_val, new_changed, conflict | new_conflict
+
+    def prop_cond(state):
+        _, changed, conflict = state
+        return changed & ~conflict.all()
+
+    val0 = jnp.zeros((I, V), dtype=jnp.int8)
+    val, _, conflict = lax.while_loop(
+        prop_cond, prop_body, (val0, jnp.bool_(True), jnp.zeros(I, dtype=bool))
+    )
+
+    lit_val = lit_values(val)
+    c_sat = (lit_val == 1).any(-1)
+    all_sat = (c_sat | ~real_clause).all(-1)  # [I]
+    status0 = jnp.where(conflict, UNSAT, jnp.where(all_sat, SAT, UNKNOWN)).astype(
+        jnp.int32
+    )
+
+    # ---- phase 2: vectorized random-order DPLL (no backtracking) ----
+    # Tseitin CNF propagates extremely well: once the free inputs of the
+    # circuit are decided, every gate output is forced by unit propagation.
+    # So the search loop alternates one propagation sweep with one random
+    # decision (only when propagation is quiescent), and on conflict simply
+    # restarts the instance from the phase-1 fixpoint with fresh randomness.
+    # Conflicts under decisions prove nothing — only phase 1 yields UNSAT.
+    fixed_val = val  # decision-free fixpoint: sound restart point
+    varmask = jnp.arange(V)[None, :] < nvars[:, None]  # [I,V]
+
+    def search_body(carry):
+        val, key, status, steps = carry
+        lit_val = lit_values(val)
+        c_sat = (lit_val == 1).any(-1)
+        n_unknown = ((lit_val == 0) & real).sum(-1)
+        dead = (real_clause & ~c_sat & (n_unknown == 0)).any(-1)  # [I]
+        allsat = (c_sat | ~real_clause).all(-1)
+        status = jnp.where((status == UNKNOWN) & allsat & ~dead, SAT, status)
+        # unit-force pass (same scatter scheme as phase 1)
+        unit = real_clause & ~c_sat & (n_unknown == 1)
+        unk_pos = jnp.argmax((lit_val == 0) & real, axis=-1)
+        u_lit = jnp.take_along_axis(lits, unk_pos[..., None], axis=-1)[..., 0]
+        u_var = jnp.clip(jnp.abs(u_lit) - 1, 0, V - 1)
+        u_val = jnp.where(u_lit > 0, 1, -1).astype(jnp.int8)
+        upd = jnp.full((I, V), -2, dtype=jnp.int8)
+        upd = upd.at[jnp.arange(I)[:, None], u_var].max(
+            jnp.where(unit, u_val, jnp.int8(-2)), mode="drop"
+        )
+        force = upd > jnp.int8(-2)
+        val2 = jnp.where((val == 0) & force, upd, val)
+        changed = (val2 != val).any(-1)  # [I]
+        # quiescent + open + consistent -> decide the LOWEST unassigned
+        # var, preferring free-symbol input bits over gate vars, with a
+        # random phase. Bit-blasted words allocate LSB-first, so in-order
+        # decisions track carry/borrow ripple instead of guessing high
+        # bits before their carries exist (random order restarts forever
+        # on adder chains); the random phase still de-correlates restarts.
+        key, k_p = jax.random.split(key)
+        cand = (val2 == 0) & varmask
+        cand_in = cand & is_input
+        use_in = cand_in.any(-1, keepdims=True)
+        pool = jnp.where(use_in, cand_in, cand)
+        prio = -jnp.arange(V, dtype=jnp.float32)[None, :]
+        dvar = jnp.argmax(jnp.where(pool, prio, -jnp.inf), axis=-1)
+        has_cand = cand.any(-1)
+        need_decide = (status == UNKNOWN) & ~dead & ~changed & has_cand
+        dphase = jnp.where(
+            jax.random.bernoulli(k_p, 0.5, (I,)), jnp.int8(1), jnp.int8(-1)
+        )
+        cur = val2[jnp.arange(I), dvar]
+        val3 = val2.at[jnp.arange(I), dvar].set(
+            jnp.where(need_decide, dphase, cur)
+        )
+        # conflict under decisions -> restart from the sound fixpoint
+        restart = dead & (status == UNKNOWN)
+        val4 = jnp.where(restart[:, None], fixed_val, val3)
+        return val4, key, status, steps + 1
+
+    def search_cond(carry):
+        _, _, status, steps = carry
+        return (steps < flips) & (status == UNKNOWN).any()
+
+    if flips > 0:
+        val, _, status, _ = lax.while_loop(
+            search_cond,
+            search_body,
+            (val, key, status0, jnp.zeros((), jnp.int32)),
+        )
+    else:
+        status = status0
+    best_assign = val > 0
+    return status, best_assign
+
+
+_jitted_kernel = None
+
+
+def _get_kernel():
+    global _jitted_kernel
+    jax, _ = _ensure_jax()
+    if _jitted_kernel is None:
+        _jitted_kernel = jax.jit(_solve_kernel, static_argnums=(4, 5))
+    return _jitted_kernel
+
+
+_seed_counter = [0]
+
+
+def check_batch(
+    constraint_sets: Sequence[Sequence[Term]],
+    flips: Optional[int] = None,
+    max_vars: int = MAX_VARS,
+    max_clauses: int = MAX_CLAUSES,
+) -> List[int]:
+    """Decide a batch of path conditions on device.
+
+    Returns one of pysat.SAT / pysat.UNSAT / pysat.UNKNOWN per input set.
+    SAT and UNSAT results are sound (see module docstring); UNKNOWN means
+    the caller should fall back to the host CDCL core.
+    """
+    results = [UNKNOWN] * len(constraint_sets)
+    max_vars = min(max_vars, MAX_VARS)
+    max_clauses = min(max_clauses, MAX_CLAUSES)
+    live_idx = []
+    live_instances = []
+    for i, cs in enumerate(constraint_sets):
+        inst = compile_cnf(cs, max_vars, max_clauses)
+        if inst is None:
+            continue
+        if inst.trivial is not None:
+            results[i] = inst.trivial
+            continue
+        live_idx.append(i)
+        live_instances.append(inst)
+    if not live_instances:
+        return results
+
+    jax, jnp = _ensure_jax()
+    kernel = _get_kernel()
+    if flips is None:
+        flips = min(2 * MAX_VARS + 512, 4096)
+    for lo in range(0, len(live_instances), MAX_BATCH):
+        chunk = live_instances[lo : lo + MAX_BATCH]
+        lits, nvars, is_input, V = _pack_batch(chunk, MAX_VARS, MAX_CLAUSES)
+        _seed_counter[0] += 1
+        key = jax.random.PRNGKey(_seed_counter[0])
+        status, _assign = kernel(
+            jnp.asarray(lits), key, jnp.asarray(nvars), jnp.asarray(is_input), V, flips
+        )
+        status = np.asarray(status)
+        for k in range(len(chunk)):
+            results[live_idx[lo + k]] = int(status[k])
+    return results
+
+
+def feasibility_batch(constraint_sets, **kw) -> List[Optional[bool]]:
+    """Frontier filtering helper: True (feasible) / False (infeasible) /
+    None (undecided on device; check on host)."""
+    out = []
+    for code in check_batch(constraint_sets, **kw):
+        if code == SAT:
+            out.append(True)
+        elif code == UNSAT:
+            out.append(False)
+        else:
+            out.append(None)
+    return out

@@ -21,7 +21,8 @@ class Model:
         """All symbol names this model assigns."""
         result: List[str] = []
         for env in self.raw:
-            result.extend(env.bv_values.keys())
+            # bv_values holds plain-name keys plus (name, size) duplicates
+            result.extend(k for k in env.bv_values.keys() if isinstance(k, str))
             result.extend(env.bool_values.keys())
             result.extend(env.arrays.keys())
         return result

@@ -32,7 +32,7 @@ class Blaster:
         self.word_cache: Dict[int, List[int]] = {}
         self.bool_cache: Dict[int, int] = {}
         self.div_cache: Dict[Tuple[int, int], Tuple[List[int], List[int]]] = {}
-        self.var_bits: Dict[str, List[int]] = {}
+        self.var_bits: Dict[Tuple[str, int], List[int]] = {}  # (name, size)
         self.bool_vars: Dict[str, int] = {}
 
     # ------------------------------------------------------------------ gates
@@ -260,10 +260,13 @@ class Blaster:
         if op == "const":
             w = self.const_word(t.params[0], n)
         elif op == "var":
-            name = t.params[0]
-            if name not in self.var_bits:
-                self.var_bits[name] = [self._new() for _ in range(n)]
-            w = self.var_bits[name]
+            # keyed by (name, size): the blaster lives for the whole process
+            # (incremental.py), where same-named vars of different widths are
+            # distinct symbols, exactly as z3 treats name+sort
+            key = (t.params[0], n)
+            if key not in self.var_bits:
+                self.var_bits[key] = [self._new() for _ in range(n)]
+            w = self.var_bits[key]
         elif op in ("add", "sub", "mul", "and", "or", "xor"):
             a, b = self.word(t.args[0]), self.word(t.args[1])
             if op == "add":
@@ -377,24 +380,5 @@ class Blaster:
     def assert_formula(self, t: Term) -> None:
         self.sat.add_clause([self.lit(t)])
 
-    # ------------------------------------------------------- model extraction
-
-    def read_var(self, name: str, size: int) -> int:
-        bits = self.var_bits.get(name)
-        if bits is None:
-            return 0
-        value = 0
-        for i, lit in enumerate(bits):
-            bit = self.sat.model_value(abs(lit))
-            if lit < 0:
-                bit = -bit
-            if bit == 1:
-                value |= 1 << i
-        return value
-
-    def read_bool(self, name: str) -> bool:
-        lit = self.bool_vars.get(name)
-        if lit is None:
-            return False
-        bit = self.sat.model_value(abs(lit))
-        return (bit == 1) if lit > 0 else (bit == -1)
+    # model extraction lives in IncrementalCore.extract_env (incremental.py),
+    # which bulk-reads the assignment via sat.model_copy()

@@ -1,6 +1,13 @@
-"""ctypes wrapper exposing the C++ CDCL solver with the PySat interface."""
+"""ctypes wrapper exposing the C++ CDCL solver with the PySat interface.
+
+Clause and variable creation are buffered host-side and shipped to the
+native engine in bulk (tsat_add_clauses / tsat_ensure_vars) right before a
+solve: per-call ctypes marshalling used to dominate bit-blasting time by
+~25x, so the wrapper batches the API instead.
+"""
 
 import ctypes
+from array import array
 from typing import Iterable, List, Optional
 
 from mythril_tpu.smt.solver import pysat
@@ -26,6 +33,12 @@ def _lib():
             ctypes.POINTER(ctypes.c_int),
             ctypes.c_int,
         ]
+        lib.tsat_add_clauses.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        lib.tsat_ensure_vars.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.tsat_solve.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int),
@@ -36,6 +49,11 @@ def _lib():
         lib.tsat_solve.restype = ctypes.c_int
         lib.tsat_model_value.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.tsat_model_value.restype = ctypes.c_int
+        lib.tsat_model_copy.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_byte),
+            ctypes.c_int,
+        ]
         lib.tsat_ok.argtypes = [ctypes.c_void_p]
         lib.tsat_ok.restype = ctypes.c_int
         _configured = True
@@ -50,6 +68,10 @@ class NativeSat:
         if self._lib is None:
             raise RuntimeError("native solver unavailable")
         self._s = self._lib.tsat_new()
+        self._nvars = 0
+        self._synced_vars = 0
+        self._pending = array("i")  # flat clause buffer, 0-separated
+        self.n_clauses = 0
 
     def __del__(self):
         try:
@@ -60,12 +82,23 @@ class NativeSat:
             pass
 
     def new_var(self) -> int:
-        return self._lib.tsat_new_var(self._s)
+        self._nvars += 1
+        return self._nvars
 
     def add_clause(self, lits: Iterable[int]) -> None:
-        arr = list(lits)
-        buf = (ctypes.c_int * len(arr))(*arr)
-        self._lib.tsat_add_clause(self._s, buf, len(arr))
+        self._pending.extend(lits)
+        self._pending.append(0)
+        self.n_clauses += 1
+
+    def _flush(self) -> None:
+        if self._nvars > self._synced_vars:
+            self._lib.tsat_ensure_vars(self._s, self._nvars)
+            self._synced_vars = self._nvars
+        if self._pending:
+            buf = (ctypes.c_int * len(self._pending)).from_buffer(self._pending)
+            self._lib.tsat_add_clauses(self._s, buf, len(self._pending))
+            del buf
+            self._pending = array("i")
 
     def solve(
         self,
@@ -73,6 +106,7 @@ class NativeSat:
         timeout_ms: Optional[int] = None,
         conflict_budget: Optional[int] = None,
     ) -> int:
+        self._flush()
         arr = list(assumptions or [])
         buf = (ctypes.c_int * len(arr))(*arr)
         return self._lib.tsat_solve(
@@ -80,10 +114,20 @@ class NativeSat:
         )
 
     def model_value(self, var: int) -> int:
+        if var > self._synced_vars:
+            return -1
         return self._lib.tsat_model_value(self._s, var)
+
+    def model_copy(self) -> array:
+        """Whole assignment as a 1-based array (index 0 unused): 1/-1/0."""
+        buf = (ctypes.c_byte * self._synced_vars)()
+        self._lib.tsat_model_copy(self._s, buf, self._synced_vars)
+        out = array("b", [0]) + array("b", buf)
+        return out
 
     @property
     def ok(self) -> bool:
+        self._flush()
         return bool(self._lib.tsat_ok(self._s))
 
 

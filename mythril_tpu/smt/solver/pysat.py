@@ -48,6 +48,7 @@ class PySat:
         self.qhead = 0
         self.var_inc = 1.0
         self.ok = True
+        self.n_clauses = 0
 
     # -- variables / clauses -------------------------------------------------
 
@@ -70,6 +71,7 @@ class PySat:
 
     def add_clause(self, lits: Iterable[int]) -> None:
         """Add a clause (backtracks to decision level 0 first)."""
+        self.n_clauses += 1
         if not self.ok:
             return
         self._cancel_until(0)
@@ -310,3 +312,7 @@ class PySat:
         if var > self.nvars or self.assign[var] == 0:
             return -1
         return self.assign[var]
+
+    def model_copy(self) -> List[int]:
+        """Whole assignment, 1-based (index 0 unused): 1/-1/0 per var."""
+        return list(self.assign)

@@ -1,23 +1,26 @@
 """Solver facade (reference surface: mythril/laser/smt/solver/solver.py).
 
-check() runs the full in-repo pipeline: theory elimination (preprocess.py)
--> bit-blasting (bitblast.py) -> CDCL SAT (native C++ or pure Python).
-Optimize adds lexicographic objective optimization via incremental solving
-under activation-literal-gated bound circuits (replacing z3.Optimize).
+check() routes through the process-global incremental core
+(smt/solver/incremental.py): theory elimination and bit-blasting are cached
+per hash-consed term for the lifetime of the process, and every query is a
+single CDCL solve under assumptions, so the shared prefix of a fork's path
+condition costs nothing after its first appearance. Optimize implements
+lexicographic minimize/maximize by binary search with assumption-gated bound
+circuits (replacing z3.Optimize) — bounds are plain gate literals passed as
+assumptions, so nothing query-local ever pollutes the shared clause database.
 """
 
 import logging
 import time
-from typing import List, Optional, Union
+from typing import List, Optional, Tuple
 
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.bitvec import BitVec
 from mythril_tpu.smt.bool_ import Bool
 from mythril_tpu.smt.model import Model
 from mythril_tpu.smt.solver import pysat
-from mythril_tpu.smt.solver.bitblast import Blaster, BlastError
-from mythril_tpu.smt.solver.native import make_sat
-from mythril_tpu.smt.solver.preprocess import eliminate_theories
+from mythril_tpu.smt.solver.bitblast import BlastError
+from mythril_tpu.smt.solver.incremental import get_core
 from mythril_tpu.smt.solver.solver_statistics import stat_smt_query
 from mythril_tpu.smt.terms import EvalEnv
 
@@ -47,9 +50,6 @@ class BaseSolver:
         self.timeout: Optional[int] = None  # milliseconds
         self.conflict_budget: Optional[int] = None
         self._model_env: Optional[EvalEnv] = None
-        self._sat = None
-        self._blaster: Optional[Blaster] = None
-        self._ack_info = None
 
     def set_timeout(self, timeout: int) -> None:
         """Set the timeout for the solver, in milliseconds."""
@@ -73,81 +73,56 @@ class BaseSolver:
     def reset(self) -> None:
         self.constraints = []
         self._model_env = None
-        self._sat = None
-        self._blaster = None
-        self._ack_info = None
 
-    # -- pipeline ------------------------------------------------------------
+    # -- shared plumbing -----------------------------------------------------
 
-    def _prepare(self, extra_terms: List[terms.Term]):
-        """Eliminate theories and blast; returns (blaster, sat, rewritten_extras)."""
-        assertion_terms = [c.raw for c in self.constraints]
-        rewritten, info = eliminate_theories(assertion_terms + list(extra_terms))
-        n = len(assertion_terms)
-        self._ack_info = info
-        self._sat = make_sat()
-        self._blaster = Blaster(self._sat)
-        # layout of `rewritten`: [assertions | extras | ackermann side conditions]
-        for t in rewritten[:n]:
-            self._blaster.assert_formula(t)
-        for t in rewritten[n + len(extra_terms):]:
-            self._blaster.assert_formula(t)
-        return rewritten[n : n + len(extra_terms)]
-
-    @stat_smt_query
-    def check(self, *extra_constraints) -> CheckResult:
-        """Returns sat/unsat/unknown for the asserted constraint set."""
+    def _gather(self, extra_constraints) -> List[terms.Term]:
         extras: List[Bool] = []
         for c in extra_constraints:
             if isinstance(c, (list, tuple)):
                 extras.extend(c)
             else:
                 extras.append(c)
+        return [c.raw for c in self.constraints] + [c.raw for c in extras]
+
+    @staticmethod
+    def _lower_all(core, all_terms) -> Optional[Tuple[List[int], List[terms.Term]]]:
+        lits: List[int] = []
+        rws: List[terms.Term] = []
+        try:
+            for t in all_terms:
+                lit, rw = core.lower(t)
+                lits.append(lit)
+                rws.append(rw)
+        except BlastError as e:
+            log.warning("bit-blasting failed: %s", e)
+            return None
+        return lits, rws
+
+    @stat_smt_query
+    def check(self, *extra_constraints) -> CheckResult:
+        """Returns sat/unsat/unknown for the asserted constraint set."""
         self._model_env = None
+        all_terms = self._gather(extra_constraints)
         # fast path: constant conflicts never reach the SAT solver
-        all_terms = [c.raw for c in self.constraints] + [c.raw for c in extras]
         if any(t is terms.FALSE for t in all_terms):
             return unsat
         if all(t is terms.TRUE for t in all_terms):
             self._model_env = EvalEnv()
             return sat
-        try:
-            rewritten_extras = self._prepare([c.raw for c in extras])
-            for t in rewritten_extras:
-                self._blaster.assert_formula(t)
-        except BlastError as e:
-            log.warning("bit-blasting failed: %s", e)
+        # fetch the core ONCE per check: get_core() may recycle the engine,
+        # which would orphan literals minted by an earlier fetch
+        core = get_core()
+        lowered = self._lower_all(core, all_terms)
+        if lowered is None:
             return unknown
-        code = self._sat.solve(
-            timeout_ms=self.timeout, conflict_budget=self.conflict_budget
+        lits, rws = lowered
+        code = core.solve(
+            lits, timeout_ms=self.timeout, conflict_budget=self.conflict_budget
         )
         if code == pysat.SAT:
-            self._model_env = self._extract_env()
+            self._model_env = core.extract_env(rws)
         return _RESULT_BY_CODE[code]
-
-    def _extract_env(self) -> EvalEnv:
-        blaster, info = self._blaster, self._ack_info
-        bv_values = {
-            name: blaster.read_var(name, len(bits))
-            for name, bits in blaster.var_bits.items()
-        }
-        bool_values = {name: blaster.read_bool(name) for name in blaster.bool_vars}
-        env0 = EvalEnv(bv_values, bool_values, {}, {}, completion=True)
-        arrays = {}
-        for arr_name, entries in info.arrays.items():
-            store = {}
-            for idx_term, var_term in entries:
-                idx_val = terms.evaluate(idx_term, env0)
-                store[idx_val] = bv_values.get(var_term.params[0], 0)
-            arrays[arr_name] = (store, 0)
-        funcs = {}
-        for fname, entries in info.funcs.items():
-            table = {}
-            for arg_terms, var_term in entries:
-                key = tuple(terms.evaluate(a, env0) for a in arg_terms)
-                table[key] = bv_values.get(var_term.params[0], 0)
-            funcs[fname] = table
-        return EvalEnv(bv_values, bool_values, arrays, funcs, completion=True)
 
     def model(self) -> Model:
         """The model for the last sat check()."""
@@ -175,14 +150,8 @@ class Optimize(BaseSolver):
 
     @stat_smt_query
     def check(self, *extra_constraints) -> CheckResult:
-        extras: List[Bool] = []
-        for c in extra_constraints:
-            if isinstance(c, (list, tuple)):
-                extras.extend(c)
-            else:
-                extras.append(c)
         self._model_env = None
-        all_terms = [c.raw for c in self.constraints] + [c.raw for c in extras]
+        all_terms = self._gather(extra_constraints)
         if any(t is terms.FALSE for t in all_terms):
             return unsat
         deadline = time.monotonic() + self.timeout / 1000.0 if self.timeout else None
@@ -192,67 +161,70 @@ class Optimize(BaseSolver):
                 return None
             return max(1, int((deadline - time.monotonic()) * 1000))
 
-        try:
-            obj_terms = [t for t, _ in self._objectives]
-            rewritten = self._prepare([c.raw for c in extras] + obj_terms)
-            rewritten_extras = rewritten[: len(extras)]
-            rewritten_objs = rewritten[len(extras):]
-            for t in rewritten_extras:
-                self._blaster.assert_formula(t)
-        except BlastError as e:
-            log.warning("bit-blasting failed: %s", e)
+        core = get_core()
+        lowered = self._lower_all(core, all_terms)
+        if lowered is None:
             return unknown
-        code = self._sat.solve(
-            timeout_ms=remaining_ms(), conflict_budget=self.conflict_budget
+        lits, rws = lowered
+        obj_words = []
+        obj_rws = []
+        try:
+            for obj_term, _ in self._objectives:
+                bits, rw = core.word(obj_term)
+                obj_words.append(bits)
+                obj_rws.append(rw)
+        except BlastError as e:
+            log.warning("bit-blasting objective failed: %s", e)
+            obj_words, obj_rws = [], []
+
+        code = core.solve(
+            lits, timeout_ms=remaining_ms(), conflict_budget=self.conflict_budget
         )
         if code != pysat.SAT:
             return _RESULT_BY_CODE[code]
-        self._model_env = self._extract_env()
+        env_rws = rws + obj_rws
+        self._model_env = core.extract_env(env_rws)
+        if not obj_words:
+            return sat
 
-        # lexicographic objective optimization by binary search on bounds
-        for (obj_term, is_min), obj_rewritten in zip(self._objectives, rewritten_objs):
-            try:
-                obj_bits = self._blaster.word(obj_rewritten)
-            except BlastError:
-                break
-            current = terms.evaluate(obj_rewritten, self._model_env)
-            lo, hi = (0, current) if is_min else (current, terms.mask(obj_rewritten.size))
+        # lexicographic binary search; bound/pin circuits are gate literals
+        # used purely as assumptions, so the shared database stays clean.
+        blaster = core.blaster
+        pins: List[int] = []
+        for (obj_term, is_min), obj_bits, obj_rw in zip(
+            self._objectives, obj_words, obj_rws
+        ):
+            current = terms.evaluate(obj_rw, self._model_env)
+            lo, hi = (0, current) if is_min else (current, terms.mask(obj_rw.size))
             while lo < hi:
                 if deadline is not None and time.monotonic() > deadline:
                     break
                 mid = (lo + hi) // 2 if is_min else (lo + hi + 1) // 2
-                bound = self._blaster.const_word(mid, len(obj_bits))
+                bound = blaster.const_word(mid, len(obj_bits))
                 if is_min:
-                    cond = -self._blaster.w_ult(bound, obj_bits)  # obj <= mid
+                    cond = -blaster.w_ult(bound, obj_bits)  # obj <= mid
                 else:
-                    cond = -self._blaster.w_ult(obj_bits, bound)  # obj >= mid
-                act = self._sat.new_var()
-                self._sat.add_clause([-act, cond])
-                code = self._sat.solve(
-                    assumptions=[act],
+                    cond = -blaster.w_ult(obj_bits, bound)  # obj >= mid
+                code = core.solve(
+                    lits + pins + [cond],
                     timeout_ms=remaining_ms(),
                     conflict_budget=self.conflict_budget,
                 )
                 if code == pysat.SAT:
-                    self._model_env = self._extract_env()
-                    val = terms.evaluate(obj_rewritten, self._model_env)
+                    self._model_env = core.extract_env(env_rws)
+                    val = terms.evaluate(obj_rw, self._model_env)
                     if is_min:
                         hi = min(val, mid)
                     else:
                         lo = max(val, mid)
-                else:
-                    self._sat.add_clause([-act])
-                    if code == pysat.UNSAT:
-                        if is_min:
-                            lo = mid + 1
-                        else:
-                            hi = mid - 1
+                elif code == pysat.UNSAT:
+                    if is_min:
+                        lo = mid + 1
                     else:
-                        break
+                        hi = mid - 1
+                else:
+                    break
             # pin the achieved optimum before the next objective
-            best = terms.evaluate(obj_rewritten, self._model_env)
-            pin = self._blaster.w_eq(
-                obj_bits, self._blaster.const_word(best, len(obj_bits))
-            )
-            self._sat.add_clause([pin])
+            best = terms.evaluate(obj_rw, self._model_env)
+            pins.append(blaster.w_eq(obj_bits, blaster.const_word(best, len(obj_bits))))
         return sat

@@ -694,7 +694,12 @@ def evaluate(term: Term, env: EvalEnv, _memo: Optional[Dict[int, Union[int, bool
         elif op == "false":
             v = False
         elif op == "var":
-            if t.params[0] in env.bv_values:
+            # sized key first: same-named vars of different widths are
+            # distinct symbols (the solver's model writes both keys)
+            sized = (t.params[0], t.size)
+            if sized in env.bv_values:
+                v = env.bv_values[sized] & mask(t.size)
+            elif t.params[0] in env.bv_values:
                 v = env.bv_values[t.params[0]] & mask(t.size)
             elif env.completion:
                 v = 0

@@ -45,3 +45,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Shrink the batched device solver's canonical kernel shapes: full-size
+# (4096 vars x 16k clauses) takes minutes to XLA-compile on the CPU mesh
+# and would eat per-test execution budgets. Small shapes still exercise
+# the whole pipeline; EVM-sized instances just fall back to the host CDCL.
+from mythril_tpu.laser.tpu import solver_jax as _solver_jax  # noqa: E402
+
+_solver_jax.MAX_VARS = 512
+_solver_jax.MAX_CLAUSES = 2048

@@ -1,0 +1,97 @@
+"""EIP-197 pairing precompile (support/bn128_pairing.py) — bilinearity and
+input-validation vectors mirroring the reference's pairing tests
+(/root/reference/tests/laser/Precompiles)."""
+
+import pytest
+
+from mythril_tpu.support import bn128_pairing as bp
+
+G1 = (1, 2)
+G1_NEG = (1, bp.P - 2)
+G2 = (
+    (
+        10857046999023057135944570762232829481370756359578518086990519993285655852781,
+        11559732032986387107991004021392285783925812861821192530917403151452391805634,
+    ),
+    (
+        8495653923123431417604973247489272438418190587263600148770280649306958101930,
+        4082367875863433681332203403145435568316851327593401208105741076214120093531,
+    ),
+)
+
+
+def enc_g1(pt):
+    if pt is None:
+        return b"\x00" * 64
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def enc_g2(pt):
+    if pt is None:
+        return b"\x00" * 128
+    (xr, xi), (yr, yi) = pt
+    # EIP-197: imaginary component first
+    return b"".join(v.to_bytes(32, "big") for v in (xi, xr, yi, yr))
+
+
+def test_empty_input_is_true():
+    assert bp.pairing_check(b"") is True
+
+
+def test_infinity_pairs_are_identity():
+    assert bp.pairing_check(enc_g1(None) + enc_g2(G2)) is True
+    assert bp.pairing_check(enc_g1(G1) + enc_g2(None)) is True
+
+
+def test_single_pairing_not_identity():
+    assert bp.pairing_check(enc_g1(G1) + enc_g2(G2)) is False
+
+
+def test_bilinearity_negation():
+    data = enc_g1(G1) + enc_g2(G2) + enc_g1(G1_NEG) + enc_g2(G2)
+    assert bp.pairing_check(data) is True
+
+
+def test_bilinearity_doubling():
+    # e(2P, Q) * e(-P, Q) * e(-P, Q) == 1
+    lam = 3 * G1[0] * G1[0] * pow(2 * G1[1], bp.P - 2, bp.P) % bp.P
+    x = (lam * lam - 2 * G1[0]) % bp.P
+    y = (lam * (G1[0] - x) - G1[1]) % bp.P
+    data = (
+        enc_g1((x, y))
+        + enc_g2(G2)
+        + enc_g1(G1_NEG)
+        + enc_g2(G2)
+        + enc_g1(G1_NEG)
+        + enc_g2(G2)
+    )
+    assert bp.pairing_check(data) is True
+
+
+def test_negated_g2_side():
+    neg_q = bp.g2_neg(G2)
+    data = enc_g1(G1) + enc_g2(G2) + enc_g1(G1) + enc_g2(neg_q)
+    assert bp.pairing_check(data) is True
+
+
+def test_bad_length_rejected():
+    with pytest.raises(ValueError):
+        bp.pairing_check(b"\x00" * 191)
+
+
+def test_point_not_on_curve_rejected():
+    bad = (1, 3)
+    with pytest.raises(ValueError):
+        bp.pairing_check(enc_g1(bad) + enc_g2(G2))
+
+
+def test_coordinate_out_of_range_rejected():
+    bad = enc_g1((bp.P, 2)) if False else bp.P.to_bytes(32, "big") + (2).to_bytes(32, "big")
+    with pytest.raises(ValueError):
+        bp.pairing_check(bad + enc_g2(G2))
+
+
+def test_g2_subgroup_membership():
+    assert bp.g2_mul(G2, bp.R) is None  # generator is in the r-torsion
+    pt = bp.g2_mul(G2, 12345)
+    assert bp.g2_mul(pt, bp.R) is None  # and so are its multiples
