@@ -1,0 +1,6 @@
+"""`python -m mythril_tpu` == `myth`."""
+
+from mythril_tpu.interfaces.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,9 @@
-"""Detection module interface (reference surface:
-mythril/analysis/module/base.py). Modules are CALLBACK-style (hooked on
-opcodes during execution) or POST-style (scan the finished statespace)."""
+"""Detection module interface.
+
+Parity surface: mythril/analysis/module/base.py. Two module kinds:
+CALLBACK modules hook opcodes and accumulate issues during execution
+(fast); POST modules scan the finished statespace. The declarative
+ProbeModule base most built-ins use lives in probe.py."""
 
 import logging
 from abc import ABC, abstractmethod
@@ -14,18 +17,16 @@ log = logging.getLogger(__name__)
 
 
 class EntryPoint(Enum):
-    """POST modules scan the statespace after execution; CALLBACK modules
-    hook opcodes during execution (much faster)."""
-
     POST = 1
     CALLBACK = 2
 
 
 class DetectionModule(ABC):
-    """Base detection module.
+    """One vulnerability detector.
 
-    Class properties: name, swc_id, description, entry_point,
-    pre_hooks/post_hooks (opcode lists; a trailing * matches prefixes)."""
+    Class-level declarations: name, swc_id, description, entry_point, and
+    the pre_hooks/post_hooks opcode lists (a trailing * is a prefix
+    wildcard, expanded by module/util.py)."""
 
     name = "Detection Module Name / Title"
     swc_id = "SWC-000"
@@ -43,15 +44,15 @@ class DetectionModule(ABC):
         self.cache = set()
 
     def execute(self, target: GlobalState) -> Optional[List[Issue]]:
-        """Entry point called by the engine's hooks."""
-        log.debug("Entering analysis module: %s", self.__class__.__name__)
+        """Hook entry point; delegates to the subclass's _execute."""
+        log.debug("Entering analysis module: %s", type(self).__name__)
         result = self._execute(target)
-        log.debug("Exiting analysis module: %s", self.__class__.__name__)
+        log.debug("Exiting analysis module: %s", type(self).__name__)
         return result
 
     @abstractmethod
     def _execute(self, target) -> Optional[List[Issue]]:
-        """Module main method (override this)."""
+        """Subclass detection logic."""
 
     def __repr__(self) -> str:
         return (

@@ -1,40 +1,56 @@
-"""Detection module registry (reference surface:
-mythril/analysis/module/loader.py)."""
+"""Detection module registry.
+
+Parity surface: mythril/analysis/module/loader.py — a singleton holding
+the 14 built-in detectors (declared as a table, instantiated lazily) plus
+anything third-party plugins register at runtime."""
 
 from typing import List, Optional
 
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.module.modules.arbitrary_jump import ArbitraryJump
-from mythril_tpu.analysis.module.modules.arbitrary_write import ArbitraryStorage
-from mythril_tpu.analysis.module.modules.delegatecall import ArbitraryDelegateCall
-from mythril_tpu.analysis.module.modules.dependence_on_origin import TxOrigin
-from mythril_tpu.analysis.module.modules.dependence_on_predictable_vars import (
-    PredictableVariables,
-)
-from mythril_tpu.analysis.module.modules.ether_thief import EtherThief
-from mythril_tpu.analysis.module.modules.exceptions import Exceptions
-from mythril_tpu.analysis.module.modules.external_calls import ExternalCalls
-from mythril_tpu.analysis.module.modules.integer import IntegerArithmetics
-from mythril_tpu.analysis.module.modules.multiple_sends import MultipleSends
-from mythril_tpu.analysis.module.modules.state_change_external_calls import (
-    StateChangeAfterCall,
-)
-from mythril_tpu.analysis.module.modules.suicide import AccidentallyKillable
-from mythril_tpu.analysis.module.modules.unchecked_retval import UncheckedRetval
-from mythril_tpu.analysis.module.modules.user_assertions import UserAssertions
 from mythril_tpu.exceptions import DetectorNotFoundError
 from mythril_tpu.support.support_utils import Singleton
 
+# (module path, class name) for every built-in detector
+_BUILTIN_DETECTORS = [
+    ("mythril_tpu.analysis.module.modules.arbitrary_jump", "ArbitraryJump"),
+    ("mythril_tpu.analysis.module.modules.arbitrary_write", "ArbitraryStorage"),
+    ("mythril_tpu.analysis.module.modules.delegatecall", "ArbitraryDelegateCall"),
+    (
+        "mythril_tpu.analysis.module.modules.dependence_on_predictable_vars",
+        "PredictableVariables",
+    ),
+    ("mythril_tpu.analysis.module.modules.dependence_on_origin", "TxOrigin"),
+    ("mythril_tpu.analysis.module.modules.ether_thief", "EtherThief"),
+    ("mythril_tpu.analysis.module.modules.exceptions", "Exceptions"),
+    ("mythril_tpu.analysis.module.modules.external_calls", "ExternalCalls"),
+    ("mythril_tpu.analysis.module.modules.integer", "IntegerArithmetics"),
+    ("mythril_tpu.analysis.module.modules.multiple_sends", "MultipleSends"),
+    (
+        "mythril_tpu.analysis.module.modules.state_change_external_calls",
+        "StateChangeAfterCall",
+    ),
+    ("mythril_tpu.analysis.module.modules.suicide", "AccidentallyKillable"),
+    ("mythril_tpu.analysis.module.modules.unchecked_retval", "UncheckedRetval"),
+    ("mythril_tpu.analysis.module.modules.user_assertions", "UserAssertions"),
+]
+
 
 class ModuleLoader(object, metaclass=Singleton):
-    """Singleton registry of detection modules; additional modules can be
-    registered via register_module (used by the plugin discovery system)."""
+    """Process-wide registry of detection modules."""
 
     def __init__(self):
-        self._modules = []
-        self._register_mythril_modules()
+        self._modules: List[DetectionModule] = []
+        self._load_builtins()
+
+    def _load_builtins(self) -> None:
+        from importlib import import_module
+
+        for module_path, class_name in _BUILTIN_DETECTORS:
+            cls = getattr(import_module(module_path), class_name)
+            self._modules.append(cls())
 
     def register_module(self, detection_module: DetectionModule):
+        """Used by the plugin discovery system for third-party detectors."""
         if not isinstance(detection_module, DetectionModule):
             raise ValueError("The passed variable is not a valid detection module")
         self._modules.append(detection_module)
@@ -44,35 +60,19 @@ class ModuleLoader(object, metaclass=Singleton):
         entry_point: Optional[EntryPoint] = None,
         white_list: Optional[List[str]] = None,
     ) -> List[DetectionModule]:
-        result = self._modules[:]
+        selected = list(self._modules)
         if white_list:
-            available_names = [type(module).__name__ for module in result]
-            for name in white_list:
-                if name not in available_names:
-                    raise DetectorNotFoundError(
-                        "Invalid detection module: {}".format(name)
-                    )
-            result = [module for module in result if type(module).__name__ in white_list]
-        if entry_point:
-            result = [module for module in result if module.entry_point == entry_point]
-        return result
-
-    def _register_mythril_modules(self):
-        self._modules.extend(
-            [
-                ArbitraryJump(),
-                ArbitraryStorage(),
-                ArbitraryDelegateCall(),
-                PredictableVariables(),
-                TxOrigin(),
-                EtherThief(),
-                Exceptions(),
-                ExternalCalls(),
-                IntegerArithmetics(),
-                MultipleSends(),
-                StateChangeAfterCall(),
-                AccidentallyKillable(),
-                UncheckedRetval(),
-                UserAssertions(),
+            known = {type(module).__name__ for module in selected}
+            unknown = [name for name in white_list if name not in known]
+            if unknown:
+                raise DetectorNotFoundError(
+                    "Invalid detection module: {}".format(unknown[0])
+                )
+            selected = [
+                module for module in selected if type(module).__name__ in white_list
             ]
-        )
+        if entry_point:
+            selected = [
+                module for module in selected if module.entry_point == entry_point
+            ]
+        return selected

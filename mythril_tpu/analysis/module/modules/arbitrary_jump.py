@@ -1,59 +1,32 @@
-"""SWC-127: jump to an arbitrary (user-controlled) location (reference
-surface: mythril/analysis/module/modules/arbitrary_jump.py)."""
+"""SWC-127: jump to a caller-controlled location.
 
-import logging
+Parity surface: mythril/analysis/module/modules/arbitrary_jump.py — an
+issue fires when a JUMP/JUMPI destination is symbolic (and the path is
+satisfiable, which the probe runner checks by solving the sequence)."""
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
-from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import ARBITRARY_JUMP
-from mythril_tpu.exceptions import UnsatError
-from mythril_tpu.laser.evm.state.global_state import GlobalState
-
-log = logging.getLogger(__name__)
 
 
-class ArbitraryJump(DetectionModule):
-    """Searches for JUMPs to a user-specified location."""
-
+class ArbitraryJump(ProbeModule):
     name = "Caller can redirect execution to arbitrary bytecode locations"
     swc_id = ARBITRARY_JUMP
     description = "Search for jumps to arbitrary locations in the bytecode"
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMP", "JUMPI"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        self.issues.extend(self._analyze_state(state))
+    title = "Jump to an arbitrary instruction"
+    severity = "High"
+    description_head = "The caller can redirect execution to arbitrary bytecode locations."
+    description_tail = (
+        "It is possible to redirect the control flow to arbitrary locations in the code. "
+        "This may allow an attacker to bypass security controls or manipulate the business logic of the "
+        "smart contract. Avoid using low-level-operations and assembly to prevent this issue."
+    )
 
-    @staticmethod
-    def _analyze_state(state):
-        jump_dest = state.mstate.stack[-1]
-        if jump_dest.symbolic is False:
-            return []
-        try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints
-            )
-        except UnsatError:
-            return []
-        issue = Issue(
-            contract=state.environment.active_account.contract_name,
-            function_name=state.environment.active_function_name,
-            address=state.get_current_instruction()["address"],
-            swc_id=ARBITRARY_JUMP,
-            title="Jump to an arbitrary instruction",
-            severity="High",
-            bytecode=state.environment.code.bytecode,
-            description_head="The caller can redirect execution to arbitrary bytecode locations.",
-            description_tail="It is possible to redirect the control flow to arbitrary locations in the code. "
-            "This may allow an attacker to bypass security controls or manipulate the business logic of the "
-            "smart contract. Avoid using low-level-operations and assembly to prevent this issue.",
-            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            transaction_sequence=transaction_sequence,
-        )
-        return [issue]
+    def probe(self, state):
+        destination = state.mstate.stack[-1]
+        if destination.symbolic:
+            yield Finding()
 
 
 detector = ArbitraryJump()

@@ -1,59 +1,39 @@
-"""SWC-124: write to an arbitrary storage location (reference surface:
-mythril/analysis/module/modules/arbitrary_write.py). Uses the deferred
-PotentialIssue pattern."""
+"""SWC-124: write to a caller-controlled storage slot.
 
-import logging
+Parity surface: mythril/analysis/module/modules/arbitrary_write.py — at
+every SSTORE, defer a potential issue constrained so the written slot
+equals an arbitrary sentinel value; promotion at transaction end proves
+the slot is truly caller-controlled."""
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
-    PotentialIssue,
-    get_potential_issues_annotation,
-)
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import WRITE_TO_ARBITRARY_STORAGE
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.smt import symbol_factory
 
-log = logging.getLogger(__name__)
+# any value a compiler-derived slot layout would never produce by itself
+SLOT_SENTINEL = 324345425435
 
 
-class ArbitraryStorage(DetectionModule):
-    """Searches for a feasible write to an arbitrary storage slot."""
-
+class ArbitraryStorage(ProbeModule):
     name = "Caller can write to arbitrary storage locations"
     swc_id = WRITE_TO_ARBITRARY_STORAGE
     description = "Search for any writes to an arbitrary storage slot"
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SSTORE"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
+    deferred = True
+    title = "The caller can write to arbitrary storage locations."
+    severity = "High"
+    description_head = "Any storage slot can be written by the caller."
+    description_tail = (
+        "It is possible to write to arbitrary storage locations. By modifying the values of "
+        "storage variables, attackers may bypass security controls or manipulate the business logic of "
+        "the smart contract."
+    )
 
-    def _analyze_state(self, state):
-        write_slot = state.mstate.stack[-1]
-        # can the slot be forced to an arbitrary sentinel value?
-        constraints = state.world_state.constraints + [
-            write_slot == symbol_factory.BitVecVal(324345425435, 256)
-        ]
-        potential_issue = PotentialIssue(
-            contract=state.environment.active_account.contract_name,
-            function_name=state.environment.active_function_name,
-            address=state.get_current_instruction()["address"],
-            swc_id=WRITE_TO_ARBITRARY_STORAGE,
-            title="The caller can write to arbitrary storage locations.",
-            severity="High",
-            bytecode=state.environment.code.bytecode,
-            description_head="Any storage slot can be written by the caller.",
-            description_tail="It is possible to write to arbitrary storage locations. By modifying the values of "
-            "storage variables, attackers may bypass security controls or manipulate the business logic of "
-            "the smart contract.",
-            detector=self,
-            constraints=constraints,
+    def probe(self, state):
+        slot = state.mstate.stack[-1]
+        yield Finding(
+            constraints=[slot == symbol_factory.BitVecVal(SLOT_SENTINEL, 256)]
         )
-        return [potential_issue]
 
 
 detector = ArbitraryStorage()

@@ -1,85 +1,53 @@
-"""SWC-112: delegatecall to an attacker-controlled address (reference
-surface: mythril/analysis/module/modules/delegatecall.py)."""
+"""SWC-112: delegatecall into an attacker-supplied contract.
 
-import logging
-from typing import List
+Parity surface: mythril/analysis/module/modules/delegatecall.py — defer a
+potential issue constrained so the callee is the attacker, gas is
+forwarded, the (fresh) return value is success, and every message-call
+sender is the attacker."""
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
-    PotentialIssue,
-    get_potential_issues_annotation,
-)
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import DELEGATECALL_TO_UNTRUSTED_CONTRACT
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.transaction.symbolic import ACTORS
 from mythril_tpu.laser.evm.transaction.transaction_models import (
     ContractCreationTransaction,
 )
 from mythril_tpu.smt import UGT, symbol_factory
 
-log = logging.getLogger(__name__)
 
-
-class ArbitraryDelegateCall(DetectionModule):
-    """Detects delegatecall to a user-supplied address."""
-
+class ArbitraryDelegateCall(ProbeModule):
     name = "Delegatecall to a user-specified address"
     swc_id = DELEGATECALL_TO_UNTRUSTED_CONTRACT
     description = "Check for invocations of delegatecall to a user-supplied address."
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["DELEGATECALL"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
+    deferred = True
+    title = "Delegatecall to user-supplied address"
+    severity = "High"
+    description_head = (
+        "The contract delegates execution to another contract with a user-supplied address."
+    )
+    description_tail = (
+        "The smart contract delegates execution to a user-supplied address.This could allow an attacker to "
+        "execute arbitrary code in the context of this contract account and manipulate the state of the "
+        "contract account or execute actions on its behalf."
+    )
 
-    def _analyze_state(self, state: GlobalState) -> List[PotentialIssue]:
-        gas = state.mstate.stack[-1]
-        to = state.mstate.stack[-2]
-
-        constraints = [
-            to == ACTORS.attacker,
-            UGT(gas, symbol_factory.BitVecVal(2300, 256)),
-            state.new_bitvec(
-                "retval_{}".format(state.get_current_instruction()["address"]), 256
-            )
-            == 1,
+    def probe(self, state):
+        gas, callee = state.mstate.stack[-1], state.mstate.stack[-2]
+        site = state.get_current_instruction()["address"]
+        pins = [
+            tx.caller == ACTORS.attacker
+            for tx in state.world_state.transaction_sequence
+            if not isinstance(tx, ContractCreationTransaction)
         ]
-        for tx in state.world_state.transaction_sequence:
-            if not isinstance(tx, ContractCreationTransaction):
-                constraints.append(tx.caller == ACTORS.attacker)
-
-        address = state.get_current_instruction()["address"]
-        log.debug(
-            "[DELEGATECALL] Detected potential delegatecall to a user-supplied address: %s",
-            address,
+        yield Finding(
+            constraints=[
+                callee == ACTORS.attacker,
+                UGT(gas, symbol_factory.BitVecVal(2300, 256)),
+                state.new_bitvec("retval_{}".format(site), 256) == 1,
+            ]
+            + pins
         )
-        description_head = (
-            "The contract delegates execution to another contract with a user-supplied address."
-        )
-        description_tail = (
-            "The smart contract delegates execution to a user-supplied address.This could allow an attacker to "
-            "execute arbitrary code in the context of this contract account and manipulate the state of the "
-            "contract account or execute actions on its behalf."
-        )
-        return [
-            PotentialIssue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=address,
-                swc_id=DELEGATECALL_TO_UNTRUSTED_CONTRACT,
-                bytecode=state.environment.code.bytecode,
-                title="Delegatecall to user-supplied address",
-                severity="High",
-                description_head=description_head,
-                description_tail=description_tail,
-                constraints=constraints,
-                detector=self,
-            )
-        ]
 
 
 detector = ArbitraryDelegateCall()

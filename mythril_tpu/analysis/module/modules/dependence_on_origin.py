@@ -1,80 +1,42 @@
-"""SWC-115: control flow depends on tx.origin (reference surface:
-mythril/analysis/module/modules/dependence_on_origin.py). Taint flows from
-the ORIGIN post-hook (annotation on the pushed symbol) to JUMPI conditions."""
+"""SWC-115: control flow depends on tx.origin.
 
-import logging
-from copy import copy
+Parity surface: mythril/analysis/module/modules/dependence_on_origin.py —
+the ORIGIN post-hook tags the pushed symbol; a JUMPI whose condition
+carries the tag is an issue."""
 
-from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import TX_ORIGIN_USAGE
-from mythril_tpu.exceptions import UnsatError
-from mythril_tpu.laser.evm.state.global_state import GlobalState
-
-log = logging.getLogger(__name__)
 
 
-class TxOriginAnnotation:
-    """Marks expressions derived from the ORIGIN instruction."""
+class OriginTaint:
+    """Expression annotation: value derives from ORIGIN."""
 
 
-class TxOrigin(DetectionModule):
-    """Detects branch conditions influenced by tx.origin."""
-
+class TxOrigin(ProbeModule):
     name = "Control flow depends on tx.origin"
     swc_id = TX_ORIGIN_USAGE
     description = "Check whether control flow decisions are influenced by tx.origin"
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI"]
     post_hooks = ["ORIGIN"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
+    title = "Dependence on tx.origin"
+    severity = "Low"
+    description_head = "Use of tx.origin as a part of authorization control."
+    description_tail = (
+        "The tx.origin environment variable has been found to influence a control flow decision. "
+        "Note that using tx.origin as a security control might cause a situation where a user "
+        "inadvertently authorizes a smart contract to perform an action on their behalf. It is "
+        "recommended to use msg.sender instead."
+    )
 
-    @staticmethod
-    def _analyze_state(state: GlobalState) -> list:
-        issues = []
-        if state.get_current_instruction()["opcode"] == "JUMPI":
-            # JUMPI pre-hook
-            for annotation in state.mstate.stack[-2].annotations:
-                if isinstance(annotation, TxOriginAnnotation):
-                    constraints = copy(state.world_state.constraints)
-                    try:
-                        transaction_sequence = solver.get_transaction_sequence(
-                            state, constraints
-                        )
-                    except UnsatError:
-                        continue
-                    description = (
-                        "The tx.origin environment variable has been found to influence a control flow decision. "
-                        "Note that using tx.origin as a security control might cause a situation where a user "
-                        "inadvertently authorizes a smart contract to perform an action on their behalf. It is "
-                        "recommended to use msg.sender instead."
-                    )
-                    issue = Issue(
-                        contract=state.environment.active_account.contract_name,
-                        function_name=state.environment.active_function_name,
-                        address=state.get_current_instruction()["address"],
-                        swc_id=TX_ORIGIN_USAGE,
-                        bytecode=state.environment.code.bytecode,
-                        title="Dependence on tx.origin",
-                        severity="Low",
-                        description_head="Use of tx.origin as a part of authorization control.",
-                        description_tail=description,
-                        gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                        transaction_sequence=transaction_sequence,
-                    )
-                    issues.append(issue)
-        else:
-            # ORIGIN post-hook
-            state.mstate.stack[-1].annotate(TxOriginAnnotation())
-        return issues
+    def probe(self, state):
+        if state.get_current_instruction()["opcode"] != "JUMPI":
+            # ORIGIN post-hook: taint the value just pushed
+            state.mstate.stack[-1].annotate(OriginTaint())
+            return
+        condition = state.mstate.stack[-2]
+        if any(isinstance(a, OriginTaint) for a in condition.annotations):
+            yield Finding()
 
 
 detector = TxOrigin()

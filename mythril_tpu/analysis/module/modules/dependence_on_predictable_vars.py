@@ -1,142 +1,117 @@
-"""SWC-116/120: control flow depends on predictable block variables
-(reference surface:
-mythril/analysis/module/modules/dependence_on_predictable_vars.py)."""
+"""SWC-116/120: control flow driven by predictable block variables.
 
-import logging
-from typing import List, cast
+Parity surface:
+mythril/analysis/module/modules/dependence_on_predictable_vars.py — the
+post-hooks of COINBASE/GASLIMIT/TIMESTAMP/NUMBER (and of BLOCKHASH when it
+was queried with a provably old block number) taint the pushed value; a
+JUMPI whose condition carries the taint reports SWC-116 (timestamp) or
+SWC-120 (other sources)."""
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
 from mythril_tpu.analysis.module.module_helpers import is_prehook
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import TIMESTAMP_DEPENDENCE, WEAK_RANDOMNESS
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.evm.state.annotation import StateAnnotation
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.smt import ULT, symbol_factory
 
-log = logging.getLogger(__name__)
+BLOCK_VARIABLE_OPS = ("COINBASE", "GASLIMIT", "TIMESTAMP", "NUMBER")
 
-predictable_ops = ["COINBASE", "GASLIMIT", "TIMESTAMP", "NUMBER"]
-
-
-class PredictableValueAnnotation:
-    """Expression annotation: value derives from a predictable environment
-    variable."""
-
-    def __init__(self, operation: str) -> None:
-        self.operation = operation
-
-
-class OldBlockNumberUsedAnnotation(StateAnnotation):
-    """State annotation: BLOCKHASH was queried with an old block number."""
+_TAIL_TEMPLATE = (
+    "{} is used to determine a control flow decision. "
+    "Note that the values of variables like coinbase, gaslimit, block number and timestamp "
+    "are predictable and can be manipulated by a malicious miner. Also keep in mind that "
+    "attackers know hashes of earlier blocks. Don't use any of those environment variables "
+    "as sources of randomness and be aware that use of these variables introduces "
+    "a certain level of trust into miners."
+)
 
 
-class PredictableVariables(DetectionModule):
-    """Detects branch conditions influenced by block.coinbase,
-    block.gaslimit, block.timestamp or block.number."""
+class PredictableTaint:
+    """Expression annotation: value derives from a predictable source."""
 
+    def __init__(self, source: str) -> None:
+        self.source = source
+
+
+class StaleBlockhashQuery(StateAnnotation):
+    """State annotation: BLOCKHASH was called with a past block number."""
+
+
+class PredictableVariables(ProbeModule):
     name = "Control flow depends on a predictable environment variable"
     swc_id = "{} {}".format(TIMESTAMP_DEPENDENCE, WEAK_RANDOMNESS)
     description = (
         "Check whether control flow decisions are influenced by block.coinbase,"
         "block.gaslimit, block.timestamp or block.number."
     )
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI", "BLOCKHASH"]
-    post_hooks = ["BLOCKHASH"] + predictable_ops
+    post_hooks = ["BLOCKHASH"] + list(BLOCK_VARIABLE_OPS)
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
+    title = "Dependence on predictable environment variable"
+    severity = "Low"
 
-    @staticmethod
-    def _analyze_state(state: GlobalState) -> list:
-        issues = []
-
+    def probe(self, state):
         if is_prehook():
             opcode = state.get_current_instruction()["opcode"]
             if opcode == "JUMPI":
-                # look for predictable state variables in the jump condition
-                for annotation in state.mstate.stack[-2].annotations:
-                    if isinstance(annotation, PredictableValueAnnotation):
-                        constraints = state.world_state.constraints
-                        try:
-                            transaction_sequence = solver.get_transaction_sequence(
-                                state, constraints
-                            )
-                        except UnsatError:
-                            continue
-                        description = (
-                            annotation.operation
-                            + " is used to determine a control flow decision. "
-                            "Note that the values of variables like coinbase, gaslimit, block number and timestamp "
-                            "are predictable and can be manipulated by a malicious miner. Also keep in mind that "
-                            "attackers know hashes of earlier blocks. Don't use any of those environment variables "
-                            "as sources of randomness and be aware that use of these variables introduces "
-                            "a certain level of trust into miners."
-                        )
-                        swc_id = (
-                            TIMESTAMP_DEPENDENCE
-                            if "timestamp" in annotation.operation
-                            else WEAK_RANDOMNESS
-                        )
-                        issue = Issue(
-                            contract=state.environment.active_account.contract_name,
-                            function_name=state.environment.active_function_name,
-                            address=state.get_current_instruction()["address"],
-                            swc_id=swc_id,
-                            bytecode=state.environment.code.bytecode,
-                            title="Dependence on predictable environment variable",
-                            severity="Low",
-                            description_head="A control flow decision is made based on {}.".format(
-                                annotation.operation
-                            ),
-                            description_tail=description,
-                            gas_used=(
-                                state.mstate.min_gas_used,
-                                state.mstate.max_gas_used,
-                            ),
-                            transaction_sequence=transaction_sequence,
-                        )
-                        issues.append(issue)
-            elif opcode == "BLOCKHASH":
-                param = state.mstate.stack[-1]
-                constraint = [
-                    ULT(param, state.environment.block_number),
-                    ULT(
-                        state.environment.block_number,
-                        symbol_factory.BitVecVal(2**255, 256),
-                    ),
-                ]
-                try:
-                    solver.get_model(state.world_state.constraints + constraint)
-                    state.annotate(OldBlockNumberUsedAnnotation())
-                except UnsatError:
-                    pass
-        else:
-            # post-hook
-            opcode = state.environment.code.instruction_list[state.mstate.pc - 1]["opcode"]
-            if opcode == "BLOCKHASH":
-                annotations = cast(
-                    List[OldBlockNumberUsedAnnotation],
-                    list(state.get_annotations(OldBlockNumberUsedAnnotation)),
-                )
-                if len(annotations):
-                    state.mstate.stack[-1].annotate(
-                        PredictableValueAnnotation("The block hash of a previous block")
-                    )
+                yield from self._branch_findings(state)
             else:
+                self._flag_stale_blockhash(state)
+            return
+        self._taint_result(state)
+
+    # -- taint sources ---------------------------------------------------
+
+    @staticmethod
+    def _flag_stale_blockhash(state) -> None:
+        """BLOCKHASH pre-hook: if the queried number can be strictly below
+        the current block, the result is a known value."""
+        queried = state.mstate.stack[-1]
+        current = state.environment.block_number
+        past_block = [
+            ULT(queried, current),
+            ULT(current, symbol_factory.BitVecVal(2 ** 255, 256)),
+        ]
+        try:
+            solver.get_model(state.world_state.constraints + past_block)
+            state.annotate(StaleBlockhashQuery())
+        except UnsatError:
+            pass
+
+    @staticmethod
+    def _taint_result(state) -> None:
+        """Post-hook: taint the value the block-context op just pushed."""
+        opcode = state.environment.code.instruction_list[state.mstate.pc - 1]["opcode"]
+        if opcode == "BLOCKHASH":
+            if any(state.get_annotations(StaleBlockhashQuery)):
                 state.mstate.stack[-1].annotate(
-                    PredictableValueAnnotation(
-                        "The block.{} environment variable".format(opcode.lower())
-                    )
+                    PredictableTaint("The block hash of a previous block")
                 )
-        return issues
+            return
+        state.mstate.stack[-1].annotate(
+            PredictableTaint("The block.{} environment variable".format(opcode.lower()))
+        )
+
+    # -- taint sink --------------------------------------------------------
+
+    def _branch_findings(self, state):
+        condition = state.mstate.stack[-2]
+        for annotation in condition.annotations:
+            if not isinstance(annotation, PredictableTaint):
+                continue
+            swc = (
+                TIMESTAMP_DEPENDENCE
+                if "timestamp" in annotation.source
+                else WEAK_RANDOMNESS
+            )
+            yield Finding(
+                swc_id=swc,
+                description_head="A control flow decision is made based on {}.".format(
+                    annotation.source
+                ),
+                description_tail=_TAIL_TEMPLATE.format(annotation.source),
+            )
 
 
 detector = PredictableVariables()

@@ -1,84 +1,53 @@
-"""SWC-105: unprotected ether withdrawal (reference surface:
-mythril/analysis/module/modules/ether_thief.py): a valid end state where the
-attacker's balance strictly increased."""
+"""SWC-105: profitable ether extraction by an arbitrary sender.
 
-import logging
-from copy import copy
+Parity surface: mythril/analysis/module/modules/ether_thief.py — after a
+CALL/STATICCALL completes, defer a potential issue constrained so the
+attacker ends strictly richer than they started, sending from their own
+EOA."""
 
-from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
-    PotentialIssue,
-    get_potential_issues_annotation,
-)
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import UNPROTECTED_ETHER_WITHDRAWAL
-from mythril_tpu.exceptions import UnsatError
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.transaction.symbolic import ACTORS
 from mythril_tpu.smt import UGT
 
-log = logging.getLogger(__name__)
 
-DESCRIPTION = """
-Search for cases where Ether can be withdrawn to a user-specified address.
-An issue is reported if there is a valid end state where the attacker has
-successfully increased their Ether balance.
-"""
-
-
-class EtherThief(DetectionModule):
-    """Searches for profitable ether extraction by arbitrary senders."""
-
+class EtherThief(ProbeModule):
     name = "Any sender can withdraw ETH from the contract account"
     swc_id = UNPROTECTED_ETHER_WITHDRAWAL
-    description = DESCRIPTION
-    entry_point = EntryPoint.CALLBACK
+    description = (
+        "Search for cases where Ether can be withdrawn to a user-specified "
+        "address: a valid end state where the attacker's balance increased."
+    )
     post_hooks = ["CALL", "STATICCALL"]
 
-    def _execute(self, state: GlobalState) -> None:
-        # post-hook: the cache is keyed on the call-site address (one before
-        # the current instruction), matching PotentialIssue.address below
-        if state.get_current_instruction()["address"] - 1 in self.cache:
-            return
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
+    deferred = True
+    title = "Unprotected Ether Withdrawal"
+    severity = "High"
+    description_head = "Any sender can withdraw Ether from the contract account."
+    description_tail = (
+        "Arbitrary senders other than the contract creator can profitably extract Ether "
+        "from the contract account. Verify the business logic carefully and make sure that appropriate "
+        "security controls are in place to prevent unexpected loss of funds."
+    )
 
-    def _analyze_state(self, state):
-        state = copy(state)
-        instruction = state.get_current_instruction()
+    def site_address(self, state):
+        # post-hook: report the call site, not the instruction after it
+        return state.get_current_instruction()["address"] - 1
 
-        constraints = copy(state.world_state.constraints)
-        constraints += [
-            UGT(
-                state.world_state.balances[ACTORS.attacker],
-                state.world_state.starting_balances[ACTORS.attacker],
-            ),
-            state.environment.sender == ACTORS.attacker,
-            state.current_transaction.caller == state.current_transaction.origin,
-        ]
-
-        try:
-            # pre-solve: only record if the attacker's balance can increase
-            solver.get_model(constraints)
-            potential_issue = PotentialIssue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=instruction["address"] - 1,  # post-hook: previous instruction
-                swc_id=UNPROTECTED_ETHER_WITHDRAWAL,
-                title="Unprotected Ether Withdrawal",
-                severity="High",
-                bytecode=state.environment.code.bytecode,
-                description_head="Any sender can withdraw Ether from the contract account.",
-                description_tail="Arbitrary senders other than the contract creator can profitably extract Ether "
-                "from the contract account. Verify the business logic carefully and make sure that appropriate "
-                "security controls are in place to prevent unexpected loss of funds.",
-                detector=self,
-                constraints=constraints,
-            )
-            return [potential_issue]
-        except UnsatError:
-            return []
+    def probe(self, state):
+        world = state.world_state
+        attacker_profits = UGT(
+            world.balances[ACTORS.attacker],
+            world.starting_balances[ACTORS.attacker],
+        )
+        tx = state.current_transaction
+        yield Finding(
+            constraints=[
+                attacker_profits,
+                state.environment.sender == ACTORS.attacker,
+                tx.caller == tx.origin,
+            ]
+        )
 
 
 detector = EtherThief()

@@ -1,98 +1,45 @@
-"""SWC-107: external call to a user-supplied address with forwarded gas
-(reference surface: mythril/analysis/module/modules/external_calls.py)."""
+"""SWC-107: gas-forwarding call to an attacker-supplied address.
 
-import logging
-from copy import copy
+Parity surface: mythril/analysis/module/modules/external_calls.py — defer
+a potential issue at every CALL whose callee can be the attacker with more
+than stipend gas forwarded (the reentrancy precondition)."""
 
-from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
-    PotentialIssue,
-    get_potential_issues_annotation,
-)
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import REENTRANCY
-from mythril_tpu.exceptions import UnsatError
-from mythril_tpu.laser.evm.natives import PRECOMPILE_COUNT
-from mythril_tpu.laser.evm.state.constraints import Constraints
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.transaction.symbolic import ACTORS
-from mythril_tpu.smt import UGT, ULT, Or, symbol_factory
+from mythril_tpu.smt import UGT, symbol_factory
 
-log = logging.getLogger(__name__)
-
-DESCRIPTION = """
-Search for external calls with unrestricted gas to a user-specified address.
-"""
+from mythril_tpu.support.opcodes import GSTIPEND as GAS_STIPEND
 
 
-def _is_precompile_call(global_state: GlobalState):
-    to = global_state.mstate.stack[-2]
-    constraints = copy(global_state.world_state.constraints)
-    constraints += [
-        Or(
-            ULT(to, symbol_factory.BitVecVal(1, 256)),
-            UGT(to, symbol_factory.BitVecVal(PRECOMPILE_COUNT, 256)),
-        )
-    ]
-    try:
-        solver.get_model(constraints)
-        return False
-    except UnsatError:
-        return True
-
-
-class ExternalCalls(DetectionModule):
-    """Searches for low-level calls that forward gas to the callee."""
-
+class ExternalCalls(ProbeModule):
     name = "External call to another contract"
     swc_id = REENTRANCY
-    description = DESCRIPTION
-    entry_point = EntryPoint.CALLBACK
+    description = (
+        "Search for external calls with unrestricted gas to a user-specified address."
+    )
     pre_hooks = ["CALL"]
 
-    def _execute(self, state: GlobalState) -> None:
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
+    deferred = True
+    title = "External Call To User-Supplied Address"
+    severity = "Low"
+    description_head = "A call to a user-supplied address is executed."
+    description_tail = (
+        "An external message call to an address specified by the caller is executed. Note that "
+        "the callee account might contain arbitrary code and could re-enter any function "
+        "within this contract. Reentering the contract in an intermediate state may lead to "
+        "unexpected behaviour. Make sure that no state modifications "
+        "are executed after this call and/or reentrancy guards are in place."
+    )
 
-    def _analyze_state(self, state: GlobalState):
-        gas = state.mstate.stack[-1]
-        to = state.mstate.stack[-2]
-        address = state.get_current_instruction()["address"]
-
-        try:
-            constraints = Constraints(
-                [UGT(gas, symbol_factory.BitVecVal(2300, 256)), to == ACTORS.attacker]
-            )
-            solver.get_transaction_sequence(
-                state, constraints + state.world_state.constraints
-            )
-
-            description_head = "A call to a user-supplied address is executed."
-            description_tail = (
-                "An external message call to an address specified by the caller is executed. Note that "
-                "the callee account might contain arbitrary code and could re-enter any function "
-                "within this contract. Reentering the contract in an intermediate state may lead to "
-                "unexpected behaviour. Make sure that no state modifications "
-                "are executed after this call and/or reentrancy guards are in place."
-            )
-            issue = PotentialIssue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=address,
-                swc_id=REENTRANCY,
-                title="External Call To User-Supplied Address",
-                bytecode=state.environment.code.bytecode,
-                severity="Low",
-                description_head=description_head,
-                description_tail=description_tail,
-                constraints=constraints,
-                detector=self,
-            )
-        except UnsatError:
-            log.debug("[EXTERNAL_CALLS] No model found.")
-            return []
-        return [issue]
+    def probe(self, state):
+        gas, callee = state.mstate.stack[-1], state.mstate.stack[-2]
+        yield Finding(
+            constraints=[
+                UGT(gas, symbol_factory.BitVecVal(GAS_STIPEND, 256)),
+                callee == ACTORS.attacker,
+            ]
+        )
 
 
 detector = ExternalCalls()

@@ -1,14 +1,22 @@
-"""SWC-101: integer overflow/underflow (reference surface:
-mythril/analysis/module/modules/integer.py).
+"""SWC-101: integer overflow / underflow.
 
-Overflow conditions are attached as expression annotations where arithmetic
-happens; when a tainted value reaches a sink (SSTORE/JUMPI/CALL/RETURN) the
-condition is solved together with the path constraints at transaction end."""
+Parity surface: mythril/analysis/module/modules/integer.py. Three stages:
+
+  1. ADD/SUB/MUL/EXP tag their result with an OverflowHazard carrying the
+     precise wrap condition (BVAddNoOverflow-family constraints);
+  2. sink hooks (SSTORE value, JUMPI condition, CALL value, RETURN data)
+     collect hazards whose value influenced persistent state or control
+     flow into a state annotation;
+  3. at transaction end every collected hazard is solved together with
+     the path condition; satisfiable wraps become issues reported at the
+     arithmetic instruction (with per-origin sat/unsat caching so shared
+     hazards are solved once).
+"""
 
 import logging
 from copy import copy
 from math import ceil, log2
-from typing import List, Set, cast
+from typing import Set
 
 from mythril_tpu.analysis import solver
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
@@ -16,7 +24,6 @@ from mythril_tpu.analysis.report import Issue
 from mythril_tpu.analysis.swc_data import INTEGER_OVERFLOW_AND_UNDERFLOW
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.evm.state.annotation import StateAnnotation
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.smt import (
     And,
     BVAddNoOverflow,
@@ -34,36 +41,86 @@ from mythril_tpu.smt import (
 
 log = logging.getLogger(__name__)
 
+WORD_BITS = 256
 
-class OverUnderflowAnnotation:
-    """Expression annotation: this value may have overflowed."""
 
-    def __init__(self, overflowing_state: GlobalState, operator: str, constraint: Bool) -> None:
-        self.overflowing_state = overflowing_state
+class OverflowHazard:
+    """Expression annotation: the tagged value wraps iff `condition`."""
+
+    __slots__ = ("origin_state", "operator", "condition")
+
+    def __init__(self, origin_state, operator: str, condition: Bool) -> None:
+        self.origin_state = origin_state
         self.operator = operator
-        self.constraint = constraint
+        self.condition = condition
 
     def __deepcopy__(self, memodict=None):
         return copy(self)
 
 
-class OverUnderflowStateAnnotation(StateAnnotation):
-    """State annotation: overflowed values used along the annotated path."""
+class HazardsReachedSink(StateAnnotation):
+    """State annotation: hazards whose value reached a sink on this path."""
 
     def __init__(self) -> None:
-        self.overflowing_state_annotations: Set[OverUnderflowAnnotation] = set()
+        self.hazards: Set[OverflowHazard] = set()
 
     def __copy__(self):
-        new_annotation = OverUnderflowStateAnnotation()
-        new_annotation.overflowing_state_annotations = copy(
-            self.overflowing_state_annotations
+        clone = HazardsReachedSink()
+        clone.hazards = copy(self.hazards)
+        return clone
+
+
+def _sink_annotation(state) -> HazardsReachedSink:
+    for annotation in state.get_annotations(HazardsReachedSink):
+        return annotation
+    annotation = HazardsReachedSink()
+    state.annotate(annotation)
+    return annotation
+
+
+def _as_bitvec(stack, index) -> BitVec:
+    value = stack[index]
+    if isinstance(value, BitVec):
+        return value
+    if isinstance(value, Bool):
+        return If(value, 1, 0)
+    stack[index] = symbol_factory.BitVecVal(value, 256)
+    return stack[index]
+
+
+def _collect(state, value) -> None:
+    if not isinstance(value, Expression):
+        return
+    sink = _sink_annotation(state)
+    for annotation in value.annotations:
+        if isinstance(annotation, OverflowHazard):
+            sink.hazards.add(annotation)
+
+
+def _exp_wrap_condition(base: BitVec, exponent: BitVec):
+    """When does base ** exponent exceed 2^256? (None = never)."""
+    if base.symbolic and exponent.symbolic:
+        return And(
+            UGT(exponent, symbol_factory.BitVecVal(WORD_BITS, 256)),
+            UGT(base, symbol_factory.BitVecVal(1, 256)),
         )
-        return new_annotation
+    if exponent.symbolic:
+        if base.value < 2:
+            return None
+        threshold = ceil(WORD_BITS / log2(base.value))
+        return UGE(exponent, symbol_factory.BitVecVal(threshold, 256))
+    if base.symbolic:
+        if exponent.value == 0:
+            return None
+        bits_per_unit = ceil(WORD_BITS / exponent.value)
+        if bits_per_unit >= WORD_BITS:
+            return None
+        return UGE(base, symbol_factory.BitVecVal(2 ** bits_per_unit, 256))
+    wraps = base.value >= 2 and exponent.value * log2(base.value) >= WORD_BITS
+    return symbol_factory.Bool(bool(wraps))
 
 
 class IntegerArithmetics(DetectionModule):
-    """Searches for integer over- and underflows."""
-
     name = "Integer overflow or underflow"
     swc_id = INTEGER_OVERFLOW_AND_UNDERFLOW
     description = (
@@ -86,204 +143,118 @@ class IntegerArithmetics(DetectionModule):
 
     def __init__(self) -> None:
         super().__init__()
-        self._ostates_satisfiable: Set[GlobalState] = set()
-        self._ostates_unsatisfiable: Set[GlobalState] = set()
+        self._origin_sat: Set[object] = set()
+        self._origin_unsat: Set[object] = set()
 
     def reset_module(self):
         super().reset_module()
-        self._ostates_satisfiable = set()
-        self._ostates_unsatisfiable = set()
+        self._origin_sat = set()
+        self._origin_unsat = set()
 
-    def _execute(self, state: GlobalState) -> None:
-        address = _get_address_from_state(state)
-        if address in self.cache:
+    # -- dispatch ----------------------------------------------------------
+
+    def _execute(self, state) -> None:
+        if state.get_current_instruction()["address"] in self.cache:
             return
         opcode = state.get_current_instruction()["opcode"]
-        funcs = {
-            "ADD": [self._handle_add],
-            "SUB": [self._handle_sub],
-            "MUL": [self._handle_mul],
-            "SSTORE": [self._handle_sstore],
-            "JUMPI": [self._handle_jumpi],
-            "CALL": [self._handle_call],
-            "RETURN": [self._handle_return, self._handle_transaction_end],
-            "STOP": [self._handle_transaction_end],
-            "EXP": [self._handle_exp],
-        }
-        for func in funcs[opcode]:
-            func(state)
-
-    def _get_args(self, state):
         stack = state.mstate.stack
-        op0, op1 = (
-            self._make_bitvec_if_not(stack, -1),
-            self._make_bitvec_if_not(stack, -2),
-        )
-        return op0, op1
+        if opcode in ("ADD", "SUB", "MUL", "EXP"):
+            self._tag_arithmetic(state, opcode)
+        elif opcode == "SSTORE":
+            _collect(state, stack[-2])
+        elif opcode == "JUMPI":
+            _collect(state, stack[-2])
+        elif opcode == "CALL":
+            _collect(state, stack[-3])
+        elif opcode == "RETURN":
+            self._collect_return_data(state)
+            self._settle(state)
+        else:  # STOP
+            self._settle(state)
 
-    def _handle_add(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVAddNoOverflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "addition", c))
+    # -- stage 1: hazard tagging -------------------------------------------
 
-    def _handle_mul(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVMulNoOverflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "multiplication", c))
-
-    def _handle_sub(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVSubNoUnderflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "subtraction", c))
-
-    def _handle_exp(self, state):
-        op0, op1 = self._get_args(state)
-        if op0.symbolic and op1.symbolic:
-            constraint = And(
-                UGT(op1, symbol_factory.BitVecVal(256, 256)),
-                UGT(op0, symbol_factory.BitVecVal(1, 256)),
-            )
-        elif op1.symbolic:
-            if op0.value < 2:
-                return
-            constraint = UGE(
-                op1, symbol_factory.BitVecVal(ceil(256 / log2(op0.value)), 256)
-            )
-        elif op0.symbolic:
-            if op1.value == 0:
-                return
-            exp = ceil(256 / op1.value)
-            if exp >= 256:
-                return
-            constraint = UGE(op0, symbol_factory.BitVecVal(2**exp, 256))
+    def _tag_arithmetic(self, state, opcode: str) -> None:
+        stack = state.mstate.stack
+        lhs = _as_bitvec(stack, -1)
+        rhs = _as_bitvec(stack, -2)
+        if opcode == "ADD":
+            operator, wrap = "addition", Not(BVAddNoOverflow(lhs, rhs, False))
+        elif opcode == "SUB":
+            operator, wrap = "subtraction", Not(BVSubNoUnderflow(lhs, rhs, False))
+        elif opcode == "MUL":
+            operator, wrap = "multiplication", Not(BVMulNoOverflow(lhs, rhs, False))
         else:
-            # concrete: overflow iff op1 * log2(op0) >= 256 (op0 >= 2)
-            overflows = op0.value >= 2 and op1.value * log2(op0.value) >= 256
-            constraint = symbol_factory.Bool(bool(overflows))
-        op0.annotate(OverUnderflowAnnotation(state, "exponentiation", constraint))
+            operator = "exponentiation"
+            wrap = _exp_wrap_condition(lhs, rhs)
+            if wrap is None:
+                return
+        lhs.annotate(OverflowHazard(state, operator, wrap))
 
-    @staticmethod
-    def _make_bitvec_if_not(stack, index):
-        value = stack[index]
-        if isinstance(value, BitVec):
-            return value
-        if isinstance(value, Bool):
-            return If(value, 1, 0)
-        stack[index] = symbol_factory.BitVecVal(value, 256)
-        return stack[index]
-
-    @staticmethod
-    def _get_description_head(annotation, _type):
-        return "The binary {} can {}.".format(annotation.operator, _type.lower())
-
-    @staticmethod
-    def _get_description_tail(annotation, _type):
-        return (
-            "It is possible to cause an integer {} in the {} operation. Prevent the {} by constraining inputs "
-            "using the require() statement or use the OpenZeppelin SafeMath library for integer arithmetic operations. "
-            "Refer to the transaction trace generated for this issue to reproduce the {}.".format(
-                _type.lower(), annotation.operator, _type.lower(), _type.lower()
-            )
-        )
-
-    @staticmethod
-    def _get_title(_type):
-        return "Integer {}".format(_type)
-
-    @staticmethod
-    def _handle_sstore(state: GlobalState) -> None:
-        stack = state.mstate.stack
-        value = stack[-2]
-        if not isinstance(value, Expression):
-            return
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for annotation in value.annotations:
-            if isinstance(annotation, OverUnderflowAnnotation):
-                state_annotation.overflowing_state_annotations.add(annotation)
-
-    @staticmethod
-    def _handle_jumpi(state):
-        stack = state.mstate.stack
-        value = stack[-2]
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for annotation in value.annotations:
-            if isinstance(annotation, OverUnderflowAnnotation):
-                state_annotation.overflowing_state_annotations.add(annotation)
-
-    @staticmethod
-    def _handle_call(state):
-        stack = state.mstate.stack
-        value = stack[-3]
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for annotation in value.annotations:
-            if isinstance(annotation, OverUnderflowAnnotation):
-                state_annotation.overflowing_state_annotations.add(annotation)
-
-    @staticmethod
-    def _handle_return(state: GlobalState) -> None:
+    def _collect_return_data(self, state) -> None:
         stack = state.mstate.stack
         offset, length = stack[-1], stack[-2]
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for element in state.mstate.memory[offset : offset + length]:
-            if not isinstance(element, Expression):
-                continue
-            for annotation in element.annotations:
-                if isinstance(annotation, OverUnderflowAnnotation):
-                    state_annotation.overflowing_state_annotations.add(annotation)
+        for cell in state.mstate.memory[offset : offset + length]:
+            _collect(state, cell)
 
-    def _handle_transaction_end(self, state: GlobalState) -> None:
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for annotation in state_annotation.overflowing_state_annotations:
-            ostate = annotation.overflowing_state
-            if ostate in self._ostates_unsatisfiable:
+    # -- stage 3: transaction-end settlement --------------------------------
+
+    def _settle(self, state) -> None:
+        for hazard in _sink_annotation(state).hazards:
+            origin = hazard.origin_state
+            if origin in self._origin_unsat:
                 continue
-            if ostate not in self._ostates_satisfiable:
-                try:
-                    constraints = ostate.world_state.constraints + [annotation.constraint]
-                    solver.get_model(constraints)
-                    self._ostates_satisfiable.add(ostate)
-                except Exception:
-                    self._ostates_unsatisfiable.add(ostate)
-                    continue
+            if origin not in self._origin_sat and not self._wrap_feasible(hazard):
+                continue
             try:
-                constraints = state.world_state.constraints + [annotation.constraint]
-                transaction_sequence = solver.get_transaction_sequence(state, constraints)
+                witness = solver.get_transaction_sequence(
+                    state, state.world_state.constraints + [hazard.condition]
+                )
             except UnsatError:
                 continue
+            self._report(state, hazard, witness)
 
-            _type = "Underflow" if annotation.operator == "subtraction" else "Overflow"
-            issue = Issue(
-                contract=ostate.environment.active_account.contract_name,
-                function_name=ostate.environment.active_function_name,
-                address=ostate.get_current_instruction()["address"],
-                swc_id=INTEGER_OVERFLOW_AND_UNDERFLOW,
-                bytecode=ostate.environment.code.bytecode,
-                title=self._get_title(_type),
-                severity="High",
-                description_head=self._get_description_head(annotation, _type),
-                description_tail=self._get_description_tail(annotation, _type),
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                transaction_sequence=transaction_sequence,
+    def _wrap_feasible(self, hazard) -> bool:
+        """Solve the wrap condition at its origin once per origin state."""
+        origin = hazard.origin_state
+        try:
+            solver.get_model(
+                origin.world_state.constraints + [hazard.condition]
             )
-            address = _get_address_from_state(ostate)
-            self.cache.add(address)
-            self.issues.append(issue)
+            self._origin_sat.add(origin)
+            return True
+        except Exception:
+            self._origin_unsat.add(origin)
+            return False
+
+    def _report(self, state, hazard, witness) -> None:
+        origin = hazard.origin_state
+        kind = "Underflow" if hazard.operator == "subtraction" else "Overflow"
+        address = origin.get_current_instruction()["address"]
+        self.cache.add(address)
+        self.issues.append(
+            Issue(
+                contract=origin.environment.active_account.contract_name,
+                function_name=origin.environment.active_function_name,
+                address=address,
+                swc_id=INTEGER_OVERFLOW_AND_UNDERFLOW,
+                bytecode=origin.environment.code.bytecode,
+                title="Integer {}".format(kind),
+                severity="High",
+                description_head="The binary {} can {}.".format(
+                    hazard.operator, kind.lower()
+                ),
+                description_tail=(
+                    "It is possible to cause an integer {0} in the {1} operation. Prevent the {0} by constraining inputs "
+                    "using the require() statement or use the OpenZeppelin SafeMath library for integer arithmetic operations. "
+                    "Refer to the transaction trace generated for this issue to reproduce the {0}.".format(
+                        kind.lower(), hazard.operator
+                    )
+                ),
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                transaction_sequence=witness,
+            )
+        )
 
 
 detector = IntegerArithmetics()
-
-
-def _get_address_from_state(state):
-    return state.get_current_instruction()["address"]
-
-
-def _get_overflowunderflow_state_annotation(state: GlobalState) -> OverUnderflowStateAnnotation:
-    state_annotations = cast(
-        List[OverUnderflowStateAnnotation],
-        list(state.get_annotations(OverUnderflowStateAnnotation)),
-    )
-    if len(state_annotations) == 0:
-        state_annotation = OverUnderflowStateAnnotation()
-        state.annotate(state_annotation)
-        return state_annotation
-    return state_annotations[0]

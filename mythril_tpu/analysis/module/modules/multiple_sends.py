@@ -1,95 +1,65 @@
-"""SWC-113: multiple external calls in one transaction (reference surface:
-mythril/analysis/module/modules/multiple_sends.py)."""
+"""SWC-113: several external calls inside one transaction.
 
-import logging
+Parity surface: mythril/analysis/module/modules/multiple_sends.py — call
+sites accumulate on a state annotation; at transaction end (RETURN/STOP)
+every call after the first is reported against its own offset."""
+
 from copy import copy
-from typing import List, cast
+from typing import List
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
-from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import MULTIPLE_SENDS
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.evm.state.annotation import StateAnnotation
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 
-log = logging.getLogger(__name__)
+CALL_OPS = ("CALL", "DELEGATECALL", "STATICCALL", "CALLCODE")
 
 
-class MultipleSendsAnnotation(StateAnnotation):
+class CallSiteTrail(StateAnnotation):
+    """Offsets of the call instructions executed on this path so far."""
+
     def __init__(self) -> None:
-        self.call_offsets: List[int] = []
+        self.offsets: List[int] = []
 
     def __copy__(self):
-        result = MultipleSendsAnnotation()
-        result.call_offsets = copy(self.call_offsets)
-        return result
+        clone = CallSiteTrail()
+        clone.offsets = copy(self.offsets)
+        return clone
 
 
-class MultipleSends(DetectionModule):
-    """Checks for multiple sends in a single transaction."""
+def call_trail(state) -> "CallSiteTrail":
+    for annotation in state.get_annotations(CallSiteTrail):
+        return annotation
+    annotation = CallSiteTrail()
+    state.annotate(annotation)
+    return annotation
 
+
+class MultipleSends(ProbeModule):
     name = "Multiple external calls in the same transaction"
     swc_id = MULTIPLE_SENDS
     description = "Check for multiple sends in a single transaction"
-    entry_point = EntryPoint.CALLBACK
-    pre_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE", "RETURN", "STOP"]
+    pre_hooks = list(CALL_OPS) + ["RETURN", "STOP"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
+    title = "Multiple Calls in a Single Transaction"
+    severity = "Low"
+    description_head = "Multiple calls are executed in the same transaction."
+    description_tail = (
+        "This call is executed following another call within the same transaction. It is possible "
+        "that the call never gets executed if a prior call fails permanently (this might be caused "
+        "intentionally by a malicious callee). If possible, refactor the code such that each transaction "
+        "only executes one external call."
+    )
+    first_match_only = True
 
-    @staticmethod
-    def _analyze_state(state: GlobalState):
+    def probe(self, state):
         instruction = state.get_current_instruction()
-
-        annotations = cast(
-            List[MultipleSendsAnnotation],
-            list(state.get_annotations(MultipleSendsAnnotation)),
-        )
-        if len(annotations) == 0:
-            state.annotate(MultipleSendsAnnotation())
-            annotations = cast(
-                List[MultipleSendsAnnotation],
-                list(state.get_annotations(MultipleSendsAnnotation)),
-            )
-        call_offsets = annotations[0].call_offsets
-
-        if instruction["opcode"] in ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]:
-            call_offsets.append(state.get_current_instruction()["address"])
-        else:  # RETURN or STOP
-            for offset in call_offsets[1:]:
-                try:
-                    transaction_sequence = get_transaction_sequence(
-                        state, state.world_state.constraints
-                    )
-                except UnsatError:
-                    continue
-                description_tail = (
-                    "This call is executed following another call within the same transaction. It is possible "
-                    "that the call never gets executed if a prior call fails permanently (this might be caused "
-                    "intentionally by a malicious callee). If possible, refactor the code such that each transaction "
-                    "only executes one external call."
-                )
-                issue = Issue(
-                    contract=state.environment.active_account.contract_name,
-                    function_name=state.environment.active_function_name,
-                    address=offset,
-                    swc_id=MULTIPLE_SENDS,
-                    bytecode=state.environment.code.bytecode,
-                    title="Multiple Calls in a Single Transaction",
-                    severity="Low",
-                    description_head="Multiple calls are executed in the same transaction.",
-                    description_tail=description_tail,
-                    gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                    transaction_sequence=transaction_sequence,
-                )
-                return [issue]
-        return []
+        trail = call_trail(state)
+        if instruction["opcode"] in CALL_OPS:
+            trail.offsets.append(instruction["address"])
+            return
+        # transaction end: flag each call after the first
+        for offset in trail.offsets[1:]:
+            yield Finding(address=offset)
 
 
 detector = MultipleSends()

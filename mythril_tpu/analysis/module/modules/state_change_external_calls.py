@@ -1,187 +1,157 @@
-"""SWC-107: state change after an external call (reentrancy variant;
-reference surface:
-mythril/analysis/module/modules/state_change_external_calls.py)."""
+"""SWC-107: persistent state accessed after an external call (reentrancy
+window).
+
+Parity surface:
+mythril/analysis/module/modules/state_change_external_calls.py — each
+gas-forwarding call annotates the path with an open reentrancy window;
+any later storage access (or value transfer) inside a window defers a
+potential issue whose constraints re-pin the original call's operands."""
 
 import logging
 from copy import copy
-from typing import List, Optional, cast
+from typing import List, Optional
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
-    PotentialIssue,
-    get_potential_issues_annotation,
-)
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import REENTRANCY
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.evm.state.annotation import StateAnnotation
 from mythril_tpu.laser.evm.state.constraints import Constraints
-from mythril_tpu.laser.evm.state.global_state import GlobalState
-from mythril_tpu.smt import BitVec, Or, UGT, symbol_factory
+from mythril_tpu.smt import UGT, Or, symbol_factory
 
 log = logging.getLogger(__name__)
 
-DESCRIPTION = """
-Check whether the account state is accessed after the execution of an
-external call
-"""
-
-CALL_LIST = ["CALL", "DELEGATECALL", "CALLCODE"]
-STATE_READ_WRITE_LIST = ["SSTORE", "SLOAD", "CREATE", "CREATE2"]
+CALL_OPS = ("CALL", "DELEGATECALL", "CALLCODE")
+STATE_ACCESS_OPS = ("SSTORE", "SLOAD", "CREATE", "CREATE2")
+from mythril_tpu.support.opcodes import GSTIPEND as GAS_STIPEND
+ATTACKER_PROBE_ADDRESS = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
 
 
-class StateChangeCallsAnnotation(StateAnnotation):
-    def __init__(self, call_state: GlobalState, user_defined_address: bool) -> None:
+class ReentrancyWindow(StateAnnotation):
+    """Open from a gas-forwarding external call until transaction end."""
+
+    def __init__(self, call_state, attacker_controlled: bool) -> None:
         self.call_state = call_state
-        self.state_change_states: List[GlobalState] = []
-        self.user_defined_address = user_defined_address
+        self.attacker_controlled = attacker_controlled
+        self.accesses: List[object] = []
 
     def __copy__(self):
-        new_annotation = StateChangeCallsAnnotation(
-            self.call_state, self.user_defined_address
-        )
-        new_annotation.state_change_states = self.state_change_states[:]
-        return new_annotation
+        clone = ReentrancyWindow(self.call_state, self.attacker_controlled)
+        clone.accesses = self.accesses[:]
+        return clone
 
-    def get_issue(self, global_state: GlobalState, detector: DetectionModule) -> Optional[PotentialIssue]:
-        if not self.state_change_states:
-            return None
-        constraints = Constraints()
+    def call_constraints(self) -> Constraints:
+        """Re-pin the original call: gas beyond the stipend, callee not a
+        precompile (or zero), and — when established at the call site —
+        attacker-chosen."""
         gas = self.call_state.mstate.stack[-1]
-        to = self.call_state.mstate.stack[-2]
-        constraints += [
-            UGT(gas, symbol_factory.BitVecVal(2300, 256)),
-            Or(
-                UGT(to, symbol_factory.BitVecVal(16, 256)),
-                to == symbol_factory.BitVecVal(0, 256),
-            ),
-        ]
-        if self.user_defined_address:
-            constraints += [to == 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF]
-
-        try:
-            solver.get_transaction_sequence(
-                global_state, constraints + global_state.world_state.constraints
-            )
-        except UnsatError:
-            return None
-
-        severity = "Medium" if self.user_defined_address else "Low"
-        address = global_state.get_current_instruction()["address"]
-        log.debug("[STATE_CHANGE] Detected state changes at address: %s", address)
-        read_or_write = "Write to"
-        if global_state.get_current_instruction()["opcode"] == "SLOAD":
-            read_or_write = "Read of"
-        address_type = "user defined" if self.user_defined_address else "fixed"
-        description_head = "{} persistent state following external call".format(read_or_write)
-        description_tail = (
-            "The contract account state is accessed after an external call to a {} address. Note that the callee "
-            "could re-enter any function in this contract before the state access has occurred. Review the contract "
-            "logic carefully and consider performing all state operations before executing the external call, "
-            "especially if the callee is not trusted.".format(address_type)
+        callee = self.call_state.mstate.stack[-2]
+        constraints = Constraints(
+            [
+                UGT(gas, symbol_factory.BitVecVal(GAS_STIPEND, 256)),
+                Or(
+                    UGT(callee, symbol_factory.BitVecVal(16, 256)),
+                    callee == symbol_factory.BitVecVal(0, 256),
+                ),
+            ]
         )
-        return PotentialIssue(
-            contract=global_state.environment.active_account.contract_name,
-            function_name=global_state.environment.active_function_name,
-            address=address,
-            title="State access after external call",
-            severity=severity,
-            description_head=description_head,
-            description_tail=description_tail,
-            swc_id=REENTRANCY,
-            bytecode=global_state.environment.code.bytecode,
-            constraints=constraints,
-            detector=detector,
-        )
+        if self.attacker_controlled:
+            constraints += [callee == ATTACKER_PROBE_ADDRESS]
+        return constraints
 
 
-class StateChangeAfterCall(DetectionModule):
-    """Searches for state accesses after low-level calls forwarding gas."""
-
+class StateChangeAfterCall(ProbeModule):
     name = "State change after an external call"
     swc_id = REENTRANCY
-    description = DESCRIPTION
-    entry_point = EntryPoint.CALLBACK
-    pre_hooks = CALL_LIST + STATE_READ_WRITE_LIST
+    description = (
+        "Check whether the account state is accessed after the execution "
+        "of an external call"
+    )
+    pre_hooks = list(CALL_OPS) + list(STATE_ACCESS_OPS)
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(issues)
+    deferred = True
+    severity = "Low"
+    title = "State access after external call"
 
-    @staticmethod
-    def _add_external_call(global_state: GlobalState) -> None:
-        gas = global_state.mstate.stack[-1]
-        to = global_state.mstate.stack[-2]
-        try:
-            constraints = copy(global_state.world_state.constraints)
-            solver.get_model(
-                constraints
-                + [
-                    UGT(gas, symbol_factory.BitVecVal(2300, 256)),
-                    Or(
-                        UGT(to, symbol_factory.BitVecVal(16, 256)),
-                        to == symbol_factory.BitVecVal(0, 256),
-                    ),
-                ]
-            )
-            # can the callee address also be attacker-chosen?
-            try:
-                constraints += [to == 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF]
-                solver.get_model(constraints)
-                global_state.annotate(StateChangeCallsAnnotation(global_state, True))
-            except UnsatError:
-                global_state.annotate(StateChangeCallsAnnotation(global_state, False))
-        except UnsatError:
-            pass
+    def probe(self, state):
+        opcode = state.get_current_instruction()["opcode"]
+        windows = list(state.get_annotations(ReentrancyWindow))
 
-    def _analyze_state(self, global_state: GlobalState) -> List[PotentialIssue]:
-        annotations = cast(
-            List[StateChangeCallsAnnotation],
-            list(global_state.get_annotations(StateChangeCallsAnnotation)),
-        )
-        op_code = global_state.get_current_instruction()["opcode"]
+        if opcode in STATE_ACCESS_OPS:
+            for window in windows:
+                window.accesses.append(state)
+        elif opcode in CALL_OPS:
+            # a nonzero value transfer is itself a balance state change
+            if self._value_can_flow(state):
+                for window in windows:
+                    window.accesses.append(state)
+            self._open_window(state)
 
-        if len(annotations) == 0 and op_code in STATE_READ_WRITE_LIST:
-            return []
-        if op_code in STATE_READ_WRITE_LIST:
-            for annotation in annotations:
-                annotation.state_change_states.append(global_state)
-
-        # state changes following from a transfer of ether
-        if op_code in CALL_LIST:
-            value: BitVec = global_state.mstate.stack[-3]
-            if StateChangeAfterCall._balance_change(value, global_state):
-                for annotation in annotations:
-                    annotation.state_change_states.append(global_state)
-
-        # record external calls
-        if op_code in CALL_LIST:
-            StateChangeAfterCall._add_external_call(global_state)
-
-        vulnerabilities = []
-        for annotation in annotations:
-            if not annotation.state_change_states:
+        for window in windows:
+            if not window.accesses:
                 continue
-            issue = annotation.get_issue(global_state, self)
-            if issue:
-                vulnerabilities.append(issue)
-        return vulnerabilities
+            finding = self._window_finding(state, window, opcode)
+            if finding is not None:
+                yield finding
+
+    # -- window bookkeeping ------------------------------------------------
 
     @staticmethod
-    def _balance_change(value: BitVec, global_state: GlobalState) -> bool:
+    def _value_can_flow(state) -> bool:
+        value = state.mstate.stack[-3]
         if not value.symbolic:
             return value.value > 0
-        constraints = copy(global_state.world_state.constraints)
         try:
             solver.get_model(
-                constraints + [UGT(value, symbol_factory.BitVecVal(0, 256))]
+                copy(state.world_state.constraints)
+                + [UGT(value, symbol_factory.BitVecVal(0, 256))]
             )
             return True
         except UnsatError:
             return False
+
+    @staticmethod
+    def _open_window(state) -> None:
+        gas = state.mstate.stack[-1]
+        callee = state.mstate.stack[-2]
+        base = copy(state.world_state.constraints)
+        try:
+            solver.get_model(
+                base
+                + [
+                    UGT(gas, symbol_factory.BitVecVal(GAS_STIPEND, 256)),
+                    Or(
+                        UGT(callee, symbol_factory.BitVecVal(16, 256)),
+                        callee == symbol_factory.BitVecVal(0, 256),
+                    ),
+                ]
+            )
+        except UnsatError:
+            return
+        try:
+            solver.get_model(base + [callee == ATTACKER_PROBE_ADDRESS])
+            state.annotate(ReentrancyWindow(state, True))
+        except UnsatError:
+            state.annotate(ReentrancyWindow(state, False))
+
+    # -- issue assembly ----------------------------------------------------
+
+    def _window_finding(self, state, window, opcode) -> Optional[Finding]:
+        access_kind = "Read of" if opcode == "SLOAD" else "Write to"
+        address_kind = "user defined" if window.attacker_controlled else "fixed"
+        return Finding(
+            constraints=list(window.call_constraints()),
+            severity="Medium" if window.attacker_controlled else "Low",
+            description_head="{} persistent state following external call".format(
+                access_kind
+            ),
+            description_tail=(
+                "The contract account state is accessed after an external call to a {} address. Note that the callee "
+                "could re-enter any function in this contract before the state access has occurred. Review the contract "
+                "logic carefully and consider performing all state operations before executing the external call, "
+                "especially if the callee is not trusted.".format(address_kind)
+            ),
+        )
 
 
 detector = StateChangeAfterCall()

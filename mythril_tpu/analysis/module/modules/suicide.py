@@ -1,102 +1,63 @@
-"""SWC-106: unprotected SELFDESTRUCT (reference surface:
-mythril/analysis/module/modules/suicide.py)."""
+"""SWC-106: SELFDESTRUCT reachable by an arbitrary sender.
 
-import logging
+Parity surface: mythril/analysis/module/modules/suicide.py — every
+message-call sender in the sequence is pinned to the attacker; the
+stronger variant (beneficiary == attacker) is tried before the plain
+reachability variant, and only one issue is reported per site."""
 
-from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import UNPROTECTED_SELFDESTRUCT
-from mythril_tpu.exceptions import UnsatError
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.transaction.symbolic import ACTORS
 from mythril_tpu.laser.evm.transaction.transaction_models import (
     ContractCreationTransaction,
 )
 from mythril_tpu.smt import And
 
-log = logging.getLogger(__name__)
+_TAIL_WITH_BALANCE = (
+    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
+    "contract account and withdraw its balance to an arbitrary address. Review the transaction trace "
+    "generated for this issue and make sure that appropriate security controls are in place to prevent "
+    "unrestricted access."
+)
+_TAIL_PLAIN = (
+    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
+    "contract account. Review the transaction trace generated for this issue and make sure that "
+    "appropriate security controls are in place to prevent unrestricted access."
+)
 
-DESCRIPTION = """
-Check if the contract can be 'accidentally' killed by anyone.
-For kill-able contracts, also check whether it is possible to direct the
-contract balance to the attacker.
-"""
+
+def attacker_is_every_sender(state):
+    """One conjunct per message call: caller == attacker == origin."""
+    pins = []
+    for tx in state.world_state.transaction_sequence:
+        if isinstance(tx, ContractCreationTransaction):
+            continue
+        pins.append(And(tx.caller == ACTORS.attacker, tx.caller == tx.origin))
+    return pins
 
 
-class AccidentallyKillable(DetectionModule):
-    """Detects SELFDESTRUCT instructions reachable by any sender."""
-
+class AccidentallyKillable(ProbeModule):
     name = "Contract can be accidentally killed by anyone"
     swc_id = UNPROTECTED_SELFDESTRUCT
-    description = DESCRIPTION
-    entry_point = EntryPoint.CALLBACK
+    description = (
+        "Check if the contract can be killed by anyone; for kill-able "
+        "contracts, also check whether the balance can be sent to the attacker."
+    )
     pre_hooks = ["SUICIDE"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
+    title = "Unprotected Selfdestruct"
+    severity = "High"
+    description_head = "Any sender can cause the contract to self-destruct."
+    first_match_only = True
 
-    @staticmethod
-    def _analyze_state(state):
-        log.debug("Suicide module: Analyzing suicide instruction")
-        instruction = state.get_current_instruction()
-        to = state.mstate.stack[-1]
-
-        description_head = "Any sender can cause the contract to self-destruct."
-
-        constraints = []
-        for tx in state.world_state.transaction_sequence:
-            if not isinstance(tx, ContractCreationTransaction):
-                constraints.append(
-                    And(tx.caller == ACTORS.attacker, tx.caller == tx.origin)
-                )
-        try:
-            try:
-                # strongest variant first: balance went to the attacker
-                transaction_sequence = solver.get_transaction_sequence(
-                    state,
-                    state.world_state.constraints
-                    + constraints
-                    + [to == ACTORS.attacker],
-                )
-                description_tail = (
-                    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
-                    "contract account and withdraw its balance to an arbitrary address. Review the transaction trace "
-                    "generated for this issue and make sure that appropriate security controls are in place to prevent "
-                    "unrestricted access."
-                )
-            except UnsatError:
-                transaction_sequence = solver.get_transaction_sequence(
-                    state, state.world_state.constraints + constraints
-                )
-                description_tail = (
-                    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
-                    "contract account. Review the transaction trace generated for this issue and make sure that "
-                    "appropriate security controls are in place to prevent unrestricted access."
-                )
-
-            issue = Issue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=instruction["address"],
-                swc_id=UNPROTECTED_SELFDESTRUCT,
-                bytecode=state.environment.code.bytecode,
-                title="Unprotected Selfdestruct",
-                severity="High",
-                description_head=description_head,
-                description_tail=description_tail,
-                transaction_sequence=transaction_sequence,
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            )
-            return [issue]
-        except UnsatError:
-            log.debug("No model found")
-        return []
+    def probe(self, state):
+        beneficiary = state.mstate.stack[-1]
+        pins = attacker_is_every_sender(state)
+        yield Finding(
+            constraints=pins + [beneficiary == ACTORS.attacker],
+            description_tail=_TAIL_WITH_BALANCE,
+        )
+        yield Finding(constraints=pins, description_tail=_TAIL_PLAIN)
 
 
 detector = AccidentallyKillable()

@@ -1,36 +1,41 @@
-"""SWC-104: unchecked call return value (reference surface:
-mythril/analysis/module/modules/unchecked_retval.py)."""
+"""SWC-104: external call return value never constrained.
 
-import logging
+Parity surface: mythril/analysis/module/modules/unchecked_retval.py — the
+post-hook of each call family instruction records the pushed return-value
+symbol; at transaction end, any recorded retval that can still be 0 on
+this path was never checked."""
+
 from copy import copy
-from typing import List, Mapping, Union, cast
+from typing import List, Tuple
 
-from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import UNCHECKED_RET_VAL
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.evm.state.annotation import StateAnnotation
-from mythril_tpu.laser.evm.state.global_state import GlobalState
-from mythril_tpu.smt import BitVec
 
-log = logging.getLogger(__name__)
+CALL_OPS = ("CALL", "DELEGATECALL", "STATICCALL", "CALLCODE")
 
 
-class UncheckedRetvalAnnotation(StateAnnotation):
+class RetvalTrail(StateAnnotation):
+    """(call site, return-value symbol) pairs seen on this path."""
+
     def __init__(self) -> None:
-        self.retvals: List[Mapping[str, Union[int, BitVec]]] = []
+        self.retvals: List[Tuple[int, object]] = []
 
     def __copy__(self):
-        result = UncheckedRetvalAnnotation()
-        result.retvals = copy(self.retvals)
-        return result
+        clone = RetvalTrail()
+        clone.retvals = copy(self.retvals)
+        return clone
 
 
-class UncheckedRetval(DetectionModule):
-    """Tests whether CALL return values are checked: at transaction end, can
-    the recorded retval still be 0 on this path?"""
+def retval_trail(state) -> "RetvalTrail":
+    for annotation in state.get_annotations(RetvalTrail):
+        return annotation
+    annotation = RetvalTrail()
+    state.annotate(annotation)
+    return annotation
 
+
+class UncheckedRetval(ProbeModule):
     name = "Return value of an external call is not checked"
     swc_id = UNCHECKED_RET_VAL
     description = (
@@ -38,77 +43,39 @@ class UncheckedRetval(DetectionModule):
         "For direct calls, the Solidity compiler auto-generates this check; "
         "for low-level calls it is omitted."
     )
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["STOP", "RETURN"]
-    post_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
+    post_hooks = list(CALL_OPS)
 
-    def _execute(self, state: GlobalState) -> None:
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
+    title = "Unchecked return value from external call."
+    severity = "Low"
+    description_head = "The return value of a message call is not checked."
+    description_tail = (
+        "External calls return a boolean value. If the callee halts with an exception, 'false' is "
+        "returned and execution continues in the caller. It is often desirable to wrap external calls "
+        "into a require() statement so the transaction is reverted if the call fails. Make sure that "
+        "no unexpected behaviour occurs if the call is unsuccessful."
+    )
 
-    def _analyze_state(self, state: GlobalState) -> list:
+    def site_address(self, state):
+        # dedup is per reported retval site, handled in probe()
+        return -1
+
+    def probe(self, state):
         instruction = state.get_current_instruction()
-
-        annotations = cast(
-            List[UncheckedRetvalAnnotation],
-            [a for a in state.get_annotations(UncheckedRetvalAnnotation)],
-        )
-        if len(annotations) == 0:
-            state.annotate(UncheckedRetvalAnnotation())
-            annotations = cast(
-                List[UncheckedRetvalAnnotation],
-                [a for a in state.get_annotations(UncheckedRetvalAnnotation)],
-            )
-        retvals = annotations[0].retvals
-
+        trail = retval_trail(state)
         if instruction["opcode"] in ("STOP", "RETURN"):
-            issues = []
-            for retval in retvals:
-                if retval["address"] in self.cache:
+            for site, retval in trail.retvals:
+                if site in self.cache:
                     continue
-                try:
-                    transaction_sequence = solver.get_transaction_sequence(
-                        state, state.world_state.constraints + [retval["retval"] == 0]
-                    )
-                except UnsatError:
-                    continue
-                description_tail = (
-                    "External calls return a boolean value. If the callee halts with an exception, 'false' is "
-                    "returned and execution continues in the caller. It is often desirable to wrap external calls "
-                    "into a require() statement so the transaction is reverted if the call fails. Make sure that "
-                    "no unexpected behaviour occurs if the call is unsuccessful."
-                )
-                issue = Issue(
-                    contract=state.environment.active_account.contract_name,
-                    function_name=state.environment.active_function_name,
-                    address=retval["address"],
-                    bytecode=state.environment.code.bytecode,
-                    title="Unchecked return value from external call.",
-                    swc_id=UNCHECKED_RET_VAL,
-                    severity="Low",
-                    description_head="The return value of a message call is not checked.",
-                    description_tail=description_tail,
-                    gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                    transaction_sequence=transaction_sequence,
-                )
-                issues.append(issue)
-            return issues
-
-        log.debug("End of call, extracting retval")
-        if state.environment.code.instruction_list[state.mstate.pc - 1]["opcode"] not in [
-            "CALL",
-            "DELEGATECALL",
-            "STATICCALL",
-            "CALLCODE",
-        ]:
-            return []
-        return_value = state.mstate.stack[-1]
-        retvals.append(
-            {"address": state.instruction["address"] - 1, "retval": return_value}
+                yield Finding(address=site, constraints=[retval == 0])
+            return
+        # call post-hook: pc already advanced past the call instruction
+        previous = state.environment.code.instruction_list[state.mstate.pc - 1]
+        if previous["opcode"] not in CALL_OPS:
+            return
+        trail.retvals.append(
+            (state.instruction["address"] - 1, state.mstate.stack[-1])
         )
-        return []
 
 
 detector = UncheckedRetval()

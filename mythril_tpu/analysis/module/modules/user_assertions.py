@@ -1,90 +1,63 @@
-"""SWC-110: user-defined assertion failures (reference surface:
-mythril/analysis/module/modules/user_assertions.py): detects
-`emit AssertionFailed(string)` events."""
+"""SWC-110: user-defined assertion failures (AssertionFailed events).
 
-import logging
+Parity surface: mythril/analysis/module/modules/user_assertions.py — a
+LOG1 whose topic is the AssertionFailed(string) hash is a reachable
+user assertion; the ABI-encoded message is decoded when concrete."""
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
-    PotentialIssue,
-    get_potential_issues_annotation,
-)
+from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
 from mythril_tpu.laser.evm import util
-from mythril_tpu.laser.evm.state.global_state import GlobalState
 
-log = logging.getLogger(__name__)
-
-assertion_failed_hash = (
+ASSERTION_FAILED_TOPIC = (
     0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0
 )
 
 
-def _decode_abi_string(memory, start: int, size: int):
-    """Decode an ABI-encoded string from memory (no eth_abi dependency);
-    returns None if any byte is symbolic."""
+def decode_event_string(memory, start: int, size: int):
+    """ABI string payload from a LOG1 memory range; None if symbolic."""
     try:
         length = util.get_concrete_int(memory.get_word_at(start + 32))
-        # the LOG1 size operand bounds the event payload; never trust the
+        # the event size operand bounds the payload; never trust the
         # in-memory length word alone (attacker-chosen, can be astronomical)
         length = min(length, max(size - 64, 0))
         raw = memory[start + 64 : start + 64 + length]
-        data = bytes(util.get_concrete_int(b) for b in raw)
-        return data.decode("utf8", errors="replace")
+        return bytes(util.get_concrete_int(b) for b in raw).decode(
+            "utf8", errors="replace"
+        )
     except (TypeError, IndexError):
         return None
 
 
-class UserAssertions(DetectionModule):
-    """Searches for user-supplied exceptions: emit AssertionFailed("Error")."""
-
+class UserAssertions(ProbeModule):
     name = "A user-defined assertion has been triggered"
     swc_id = ASSERT_VIOLATION
     description = "Search for reachable user-supplied exceptions (AssertionFailed events)."
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["LOG1"]
 
-    def _execute(self, state: GlobalState) -> None:
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
+    deferred = True
+    title = "Assertion Failed"
+    severity = "Medium"
+    description_head = "A user-provided assertion failed."
 
-    def _analyze_state(self, state: GlobalState):
-        topic, size, mem_start = state.mstate.stack[-3:]
-
-        if topic.symbolic or topic.value != assertion_failed_hash:
-            return []
-
+    def probe(self, state):
+        mem_start, size, topic = (
+            state.mstate.stack[-1],
+            state.mstate.stack[-2],
+            state.mstate.stack[-3],
+        )
+        if topic.symbolic or topic.value != ASSERTION_FAILED_TOPIC:
+            return
         message = None
         if not mem_start.symbolic and not size.symbolic:
-            message = _decode_abi_string(
+            message = decode_event_string(
                 state.mstate.memory, mem_start.value, size.value
             )
-
-        description_head = "A user-provided assertion failed."
-        if message:
-            description_tail = "A user-provided assertion failed with the message '{}'".format(
-                message
-            )
-        else:
-            description_tail = "A user-provided assertion failed."
-
-        address = state.get_current_instruction()["address"]
-        return [
-            PotentialIssue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=address,
-                swc_id=ASSERT_VIOLATION,
-                title="Assertion Failed",
-                bytecode=state.environment.code.bytecode,
-                severity="Medium",
-                description_head=description_head,
-                description_tail=description_tail,
-                constraints=[],
-                detector=self,
-            )
-        ]
+        tail = (
+            "A user-provided assertion failed with the message '{}'".format(message)
+            if message
+            else "A user-provided assertion failed."
+        )
+        yield Finding(description_tail=tail)
 
 
 detector = UserAssertions()

@@ -1,0 +1,153 @@
+"""Declarative detector framework.
+
+The reference implements every detection module as a free-standing class
+that repeats the same machinery: an address-dedup cache, a solver call
+(immediate `get_transaction_sequence` or deferred `PotentialIssue`), and
+Issue assembly (mythril/analysis/module/modules/*.py). Here that machinery
+lives ONCE: a module is a `ProbeModule` subclass that declares its hook
+surface and issue text and emits `Finding`s from `probe()`; the shared
+runner turns findings into Issues or PotentialIssues.
+
+Semantics parity notes:
+- the dedup cache is keyed on the reported instruction address, exactly as
+  the reference modules key theirs;
+- a deferred finding is pre-checked with `solver.get_model` (cheap sat
+  check on the extended constraints) before being parked as a
+  PotentialIssue for tx-end promotion — the reference's EtherThief /
+  ArbitraryStorage / ArbitraryDelegateCall / ExternalCalls pattern;
+- an immediate finding solves `get_transaction_sequence` on the spot and
+  silently drops on UnsatError — the reference's Exceptions / TxOrigin /
+  Suicide pattern.
+"""
+
+import logging
+from copy import copy
+from typing import Iterable, List, Optional
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.evm.state.global_state import GlobalState
+
+log = logging.getLogger(__name__)
+
+
+class Finding:
+    """One suspected issue site emitted by a module's probe()."""
+
+    __slots__ = (
+        "constraints",
+        "address",
+        "title",
+        "severity",
+        "description_head",
+        "description_tail",
+        "deferred",
+        "swc_id",
+    )
+
+    def __init__(
+        self,
+        constraints=None,
+        address: Optional[int] = None,
+        title: Optional[str] = None,
+        severity: Optional[str] = None,
+        description_head: Optional[str] = None,
+        description_tail: Optional[str] = None,
+        deferred: Optional[bool] = None,
+        swc_id: Optional[str] = None,
+    ):
+        self.constraints = list(constraints or [])
+        self.address = address
+        self.title = title
+        self.severity = severity
+        self.description_head = description_head
+        self.description_tail = description_tail
+        self.deferred = deferred
+        self.swc_id = swc_id
+
+
+class ProbeModule(DetectionModule):
+    """Hook-driven detector speaking in Findings.
+
+    Subclasses declare: name, swc_id, description, pre_hooks/post_hooks,
+    title, severity, description_head, description_tail, deferred — and
+    implement probe(state)."""
+
+    entry_point = EntryPoint.CALLBACK
+    title = "Issue"
+    severity = "Medium"
+    description_head = ""
+    description_tail = ""
+    deferred = False
+    # immediate modules may declare finding ALTERNATIVES: stop at the
+    # first one that solves (e.g. suicide's to==attacker variant first)
+    first_match_only = False
+
+    def probe(self, state: GlobalState) -> Iterable[Finding]:
+        """Yield Findings for this state (may be empty)."""
+        raise NotImplementedError
+
+    # -- shared runner -------------------------------------------------------
+
+    def site_address(self, state: GlobalState) -> int:
+        """The address an issue at this state reports (and dedups on).
+        Post-hooked modules see the pc already advanced; they override
+        this to point back at the hooked instruction."""
+        return state.get_current_instruction()["address"]
+
+    def _execute(self, state: GlobalState) -> None:
+        if self.site_address(state) in self.cache:
+            return
+        for finding in self.probe(state) or ():
+            materialized = self._materialize(state, finding)
+            if materialized and self.first_match_only:
+                break
+
+    def _materialize(self, state: GlobalState, finding: Finding) -> bool:
+        address = finding.address if finding.address is not None else self.site_address(state)
+        deferred = self.deferred if finding.deferred is None else finding.deferred
+        env = state.environment
+        common = dict(
+            contract=env.active_account.contract_name,
+            function_name=env.active_function_name,
+            address=address,
+            swc_id=finding.swc_id or self.swc_id,
+            title=finding.title or self.title,
+            severity=finding.severity or self.severity,
+            description_head=finding.description_head or self.description_head,
+            description_tail=finding.description_tail or self.description_tail,
+            bytecode=env.code.bytecode,
+        )
+        constraints = copy(state.world_state.constraints)
+        constraints += finding.constraints
+
+        if deferred:
+            try:
+                solver.get_model(constraints)
+            except UnsatError:
+                return False
+            annotation = get_potential_issues_annotation(state)
+            annotation.potential_issues.append(
+                PotentialIssue(detector=self, constraints=constraints, **common)
+            )
+            return True
+
+        try:
+            transaction_sequence = solver.get_transaction_sequence(state, constraints)
+        except UnsatError:
+            return False
+        self.cache.add(address)
+        self.issues.append(
+            Issue(
+                transaction_sequence=transaction_sequence,
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                **common,
+            )
+        )
+        return True

@@ -1,8 +1,10 @@
-"""Hook wiring for detection modules (reference surface:
-mythril/analysis/module/util.py)."""
+"""Hook wiring for detection modules.
+
+Parity surface: mythril/analysis/module/util.py — expands each module's
+pre/post hook declarations (opcode names, or prefix wildcards such as
+"PUSH*") into the {opcode: [callbacks]} dict the engine consumes."""
 
 import logging
-from collections import defaultdict
 from typing import Callable, Dict, List, Optional
 
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
@@ -10,35 +12,43 @@ from mythril_tpu.analysis.module.loader import ModuleLoader
 from mythril_tpu.support.opcodes import NAME_SPECS
 
 log = logging.getLogger(__name__)
-OP_CODE_LIST = list(NAME_SPECS.keys())
+
+_ALL_OPCODES = tuple(NAME_SPECS.keys())
+
+
+def _expand(pattern: str) -> List[str]:
+    """An opcode name, or a 'PREFIX*' wildcard, to concrete opcode names."""
+    pattern = pattern.upper()
+    if pattern in NAME_SPECS:
+        return [pattern]
+    if pattern.endswith("*"):
+        prefix = pattern[:-1]
+        return [name for name in _ALL_OPCODES if name.startswith(prefix)]
+    return []
 
 
 def get_detection_module_hooks(
-    modules: List[DetectionModule], hook_type="pre"
+    modules: List[DetectionModule], hook_type: str = "pre"
 ) -> Dict[str, List[Callable]]:
-    """Hook dict for the given modules; a hook entry is either an opcode name
-    or a prefix wildcard like "PUSH*"."""
-    hook_dict: Dict[str, List[Callable]] = defaultdict(list)
+    hooks: Dict[str, List[Callable]] = {}
     for module in modules:
-        hooks = module.pre_hooks if hook_type == "pre" else module.post_hooks
-        for op_code in map(lambda x: x.upper(), hooks):
-            if op_code in OP_CODE_LIST:
-                hook_dict[op_code].append(module.execute)
-            elif op_code.endswith("*"):
-                to_register = filter(lambda x: x.startswith(op_code[:-1]), OP_CODE_LIST)
-                for actual_hook in to_register:
-                    hook_dict[actual_hook].append(module.execute)
-            else:
+        declared = module.pre_hooks if hook_type == "pre" else module.post_hooks
+        for pattern in declared:
+            expanded = _expand(pattern)
+            if not expanded:
                 log.error(
                     "Encountered invalid hook opcode %s in module %s",
-                    op_code,
+                    pattern,
                     module.name,
                 )
-    return dict(hook_dict)
+            for opcode in expanded:
+                hooks.setdefault(opcode, []).append(module.execute)
+    return hooks
 
 
-def reset_callback_modules(module_names: Optional[List[str]] = None):
+def reset_callback_modules(module_names: Optional[List[str]] = None) -> None:
     """Clean the issue records of every callback-based module."""
-    modules = ModuleLoader().get_detection_modules(EntryPoint.CALLBACK, module_names)
-    for module in modules:
+    for module in ModuleLoader().get_detection_modules(
+        EntryPoint.CALLBACK, module_names
+    ):
         module.reset_module()

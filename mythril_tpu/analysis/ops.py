@@ -1,5 +1,8 @@
-"""Helpers for dealing with EVM operations in the statespace (reference
-surface: mythril/analysis/ops.py)."""
+"""Statespace operation records for POST-style analysis.
+
+Parity surface: mythril/analysis/ops.py — lightweight records of CALL /
+SSTORE operations extracted from the explored statespace, each value
+wrapped with its concreteness."""
 
 from enum import Enum
 
@@ -8,14 +11,14 @@ from mythril_tpu.smt import simplify
 
 
 class VarType(Enum):
-    """Whether a value is symbolic or concrete."""
-
     SYMBOLIC = 1
     CONCRETE = 2
 
 
 class Variable:
-    """A value together with its VarType."""
+    """A value plus whether it is concrete or symbolic."""
+
+    __slots__ = ("val", "type")
 
     def __init__(self, val, _type):
         self.val = val
@@ -25,15 +28,18 @@ class Variable:
         return str(self.val)
 
 
-def get_variable(i) -> Variable:
+def get_variable(value) -> Variable:
+    """Concretize if possible, else keep the simplified symbolic form."""
     try:
-        return Variable(util.get_concrete_int(i), VarType.CONCRETE)
+        return Variable(util.get_concrete_int(value), VarType.CONCRETE)
     except TypeError:
-        return Variable(simplify(i), VarType.SYMBOLIC)
+        return Variable(simplify(value), VarType.SYMBOLIC)
 
 
 class Op:
-    """Base type for operations referencing current node and state."""
+    """An operation anchored at (node, state, index) in the statespace."""
+
+    __slots__ = ("node", "state", "state_index")
 
     def __init__(self, node, state, state_index):
         self.node = node
@@ -42,29 +48,19 @@ class Op:
 
 
 class Call(Op):
-    """A recorded CALL-family operation."""
+    __slots__ = ("to", "gas", "type", "value", "data")
 
-    def __init__(
-        self,
-        node,
-        state,
-        state_index,
-        _type,
-        to,
-        gas,
-        value=Variable(0, VarType.CONCRETE),
-        data=None,
-    ):
+    def __init__(self, node, state, state_index, _type, to, gas, value=None, data=None):
         super().__init__(node, state, state_index)
         self.to = to
         self.gas = gas
         self.type = _type
-        self.value = value
+        self.value = value if value is not None else Variable(0, VarType.CONCRETE)
         self.data = data
 
 
 class SStore(Op):
-    """A recorded SSTORE operation."""
+    __slots__ = ("value",)
 
     def __init__(self, node, state, state_index, value):
         super().__init__(node, state, state_index)

@@ -1,8 +1,11 @@
-"""Deferred-solve issue pattern (reference surface:
-mythril/analysis/potential_issues.py): detection modules record
-PotentialIssues with extra constraints; at transaction end the engine tries
-to concretize a witnessing transaction sequence and promotes survivors to
-real Issues."""
+"""Deferred-solve issue pipeline.
+
+Parity surface: mythril/analysis/potential_issues.py. Detection modules
+park cheap "potential" findings (issue text + extra constraints, no
+witness) on the state; the engine settles the whole batch at transaction
+end, concretizing a witnessing transaction sequence for each and promoting
+the survivors onto their detectors. One annotation instance rides each
+path, surviving inter-contract calls."""
 
 from mythril_tpu.analysis.report import Issue
 from mythril_tpu.analysis.solver import get_transaction_sequence
@@ -11,8 +14,58 @@ from mythril_tpu.laser.evm.state.annotation import StateAnnotation
 from mythril_tpu.laser.evm.state.global_state import GlobalState
 
 
+class PotentialIssuesAnnotation(StateAnnotation):
+    def __init__(self):
+        self.potential_issues = []
+
+    @property
+    def persist_over_calls(self) -> bool:
+        return True
+
+
+def get_potential_issues_annotation(state: GlobalState) -> PotentialIssuesAnnotation:
+    """The state's annotation, created on first use."""
+    for annotation in state.annotations:
+        if isinstance(annotation, PotentialIssuesAnnotation):
+            return annotation
+    annotation = PotentialIssuesAnnotation()
+    state.annotate(annotation)
+    return annotation
+
+
+def check_potential_issues(state: GlobalState) -> None:
+    """Transaction end: solve every parked finding against the final path
+    condition; promote the satisfiable ones, keep the rest parked."""
+    annotation = get_potential_issues_annotation(state)
+    unsettled = []
+    for potential_issue in annotation.potential_issues:
+        try:
+            witness = get_transaction_sequence(
+                state, state.world_state.constraints + potential_issue.constraints
+            )
+        except UnsatError:
+            unsettled.append(potential_issue)
+            continue
+        potential_issue.promote(state, witness)
+    annotation.potential_issues = unsettled
+
+
 class PotentialIssue:
-    """An issue missing only its transaction sequence."""
+    """Issue text + constraints, awaiting a witness."""
+
+    __slots__ = (
+        "title",
+        "contract",
+        "function_name",
+        "address",
+        "description_head",
+        "description_tail",
+        "severity",
+        "swc_id",
+        "bytecode",
+        "constraints",
+        "detector",
+    )
 
     def __init__(
         self,
@@ -40,52 +93,21 @@ class PotentialIssue:
         self.constraints = constraints or []
         self.detector = detector
 
-
-class PotentialIssuesAnnotation(StateAnnotation):
-    def __init__(self):
-        self.potential_issues = []
-
-    @property
-    def persist_over_calls(self) -> bool:
-        return True
-
-
-def get_potential_issues_annotation(state: GlobalState) -> PotentialIssuesAnnotation:
-    """The state's PotentialIssuesAnnotation (created on demand)."""
-    for annotation in state.annotations:
-        if isinstance(annotation, PotentialIssuesAnnotation):
-            return annotation
-    annotation = PotentialIssuesAnnotation()
-    state.annotate(annotation)
-    return annotation
-
-
-def check_potential_issues(state: GlobalState) -> None:
-    """Called at transaction end: try to concretize each potential issue's
-    constraints; on success promote it to a real Issue on its detector."""
-    annotation = get_potential_issues_annotation(state)
-    for potential_issue in annotation.potential_issues[:]:
-        try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints + potential_issue.constraints
-            )
-        except UnsatError:
-            continue
-
-        annotation.potential_issues.remove(potential_issue)
-        potential_issue.detector.cache.add(potential_issue.address)
-        potential_issue.detector.issues.append(
+    def promote(self, state: GlobalState, transaction_sequence) -> None:
+        """Hand the finished Issue to the detector that parked this."""
+        self.detector.cache.add(self.address)
+        self.detector.issues.append(
             Issue(
-                contract=potential_issue.contract,
-                function_name=potential_issue.function_name,
-                address=potential_issue.address,
-                title=potential_issue.title,
-                bytecode=potential_issue.bytecode,
-                swc_id=potential_issue.swc_id,
+                contract=self.contract,
+                function_name=self.function_name,
+                address=self.address,
+                title=self.title,
+                bytecode=self.bytecode,
+                swc_id=self.swc_id,
                 gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                severity=potential_issue.severity,
-                description_head=potential_issue.description_head,
-                description_tail=potential_issue.description_tail,
+                severity=self.severity,
+                description_head=self.description_head,
+                description_tail=self.description_tail,
                 transaction_sequence=transaction_sequence,
             )
         )

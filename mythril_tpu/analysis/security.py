@@ -1,5 +1,8 @@
-"""Firing detection modules at the statespace (reference surface:
-mythril/analysis/security.py)."""
+"""Firing detection modules.
+
+Parity surface: mythril/analysis/security.py — POST modules scan the
+finished statespace; CALLBACK modules already accumulated issues through
+their hooks and are drained (then reset) here."""
 
 import logging
 from typing import List, Optional
@@ -13,25 +16,25 @@ log = logging.getLogger(__name__)
 
 
 def retrieve_callback_issues(white_list: Optional[List[str]] = None) -> List[Issue]:
-    """Issues discovered by callback-type detection modules."""
-    issues: List[Issue] = []
+    """Drain (and reset) the callback modules' accumulated issues."""
+    collected: List[Issue] = []
     for module in ModuleLoader().get_detection_modules(
         entry_point=EntryPoint.CALLBACK, white_list=white_list
     ):
         log.debug("Retrieving results for %s", module.name)
-        issues += module.issues
+        collected.extend(module.issues)
     reset_callback_modules(module_names=white_list)
-    return issues
+    return collected
 
 
 def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issue]:
-    """Run POST modules over the statespace and collect callback issues."""
+    """POST modules over the statespace, then the callback harvest."""
     log.info("Starting analysis")
-    issues: List[Issue] = []
+    collected: List[Issue] = []
     for module in ModuleLoader().get_detection_modules(
         entry_point=EntryPoint.POST, white_list=white_list
     ):
         log.info("Executing %s", module.name)
-        issues += module.execute(statespace) or []
-    issues += retrieve_callback_issues(white_list)
-    return issues
+        collected.extend(module.execute(statespace) or [])
+    collected.extend(retrieve_callback_issues(white_list))
+    return collected

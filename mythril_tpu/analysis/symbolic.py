@@ -1,7 +1,10 @@
-"""Wrapper around the engine for analysis purposes (reference surface:
-mythril/analysis/symbolic.py — SymExecWrapper): builds the LaserEVM with the
-chosen strategy, loads plugins, registers detection-module hooks, runs
-symbolic execution and post-collects Call ops for POST modules."""
+"""Engine assembly for an analysis run.
+
+Parity surface: mythril/analysis/symbolic.py (SymExecWrapper). One object
+wires everything a run needs: strategy selection (including the tpu-batch
+device backend), the ACTORS world, pruning/coverage plugins, detection
+module hooks — then executes and post-parses CALL-family operations from
+the statespace for POST-style modules."""
 
 import logging
 from typing import List, Optional, Type, Union
@@ -34,9 +37,38 @@ from mythril_tpu.smt import BitVec, symbol_factory
 
 log = logging.getLogger(__name__)
 
+CALL_FAMILY = ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL")
+
+
+def _pick_strategy(name: str) -> Type[BasicSearchStrategy]:
+    if name == "dfs":
+        return DepthFirstSearchStrategy
+    if name == "bfs":
+        return BreadthFirstSearchStrategy
+    if name == "naive-random":
+        return ReturnRandomNaivelyStrategy
+    if name == "weighted-random":
+        return ReturnWeightedRandomStrategy
+    if name == "tpu-batch":
+        # the hybrid host/device backend (laser/tpu/backend.py):
+        # LaserEVM.exec delegates message-call rounds to the batched
+        # device engine behind this strategy marker
+        from mythril_tpu.laser.tpu.backend import TpuBatchStrategy
+
+        return TpuBatchStrategy
+    raise ValueError("Invalid strategy argument supplied")
+
+
+def _as_address(address: Union[int, str, BitVec]) -> BitVec:
+    if isinstance(address, str):
+        return symbol_factory.BitVecVal(int(address, 16), 256)
+    if isinstance(address, int):
+        return symbol_factory.BitVecVal(address, 256)
+    return address
+
 
 class SymExecWrapper:
-    """Symbolically executes the code and pre-parses calls for POST modules."""
+    """Runs symbolic execution and pre-parses calls for POST modules."""
 
     def __init__(
         self,
@@ -57,165 +89,136 @@ class SymExecWrapper:
         enable_coverage_strategy: bool = False,
         custom_modules_directory: str = "",
     ):
-        if isinstance(address, str):
-            address = symbol_factory.BitVecVal(int(address, 16), 256)
-        if isinstance(address, int):
-            address = symbol_factory.BitVecVal(address, 256)
+        # every analysis starts from a fresh incremental solver core:
+        # clause-database growth from prior contracts/runs in the same
+        # process would slow budgeted feasibility checks unpredictably
+        # (order-dependent false negatives otherwise)
+        from mythril_tpu.smt.solver.incremental import reset_core
 
-        if strategy == "dfs":
-            s_strategy: Type[BasicSearchStrategy] = DepthFirstSearchStrategy
-        elif strategy == "bfs":
-            s_strategy = BreadthFirstSearchStrategy
-        elif strategy == "naive-random":
-            s_strategy = ReturnRandomNaivelyStrategy
-        elif strategy == "weighted-random":
-            s_strategy = ReturnWeightedRandomStrategy
-        elif strategy == "tpu-batch":
-            # the hybrid host/device backend (laser/tpu/backend.py):
-            # LaserEVM.exec delegates the message-call rounds to the
-            # batched device engine behind this strategy marker
-            from mythril_tpu.laser.tpu.backend import TpuBatchStrategy
+        reset_core()
 
-            s_strategy = TpuBatchStrategy
-        else:
-            raise ValueError("Invalid strategy argument supplied")
-
-        creator_account = Account(
-            hex(ACTORS.creator.value), "", dynamic_loader=None, contract_name=None
-        )
-        attacker_account = Account(
-            hex(ACTORS.attacker.value), "", dynamic_loader=None, contract_name=None
-        )
-
+        address = _as_address(address)
         requires_statespace = (
             compulsory_statespace
             or len(ModuleLoader().get_detection_modules(EntryPoint.POST, modules)) > 0
         )
-        if not contract.creation_code:
-            self.accounts = {hex(ACTORS.attacker.value): attacker_account}
-        else:
-            self.accounts = {
-                hex(ACTORS.creator.value): creator_account,
-                hex(ACTORS.attacker.value): attacker_account,
-            }
 
-        instruction_laser_plugin = PluginFactory.build_instruction_coverage_plugin()
+        # the fixed-actor accounts every analysis world starts from
+        attacker = Account(
+            hex(ACTORS.attacker.value), "", dynamic_loader=None, contract_name=None
+        )
+        self.accounts = {hex(ACTORS.attacker.value): attacker}
+        if contract.creation_code:
+            creator = Account(
+                hex(ACTORS.creator.value), "", dynamic_loader=None, contract_name=None
+            )
+            self.accounts[hex(ACTORS.creator.value)] = creator
+
+        coverage_plugin = PluginFactory.build_instruction_coverage_plugin()
 
         self.laser = svm.LaserEVM(
             dynamic_loader=dynloader,
             max_depth=max_depth,
             execution_timeout=execution_timeout,
-            strategy=s_strategy,
+            strategy=_pick_strategy(strategy),
             create_timeout=create_timeout,
             transaction_count=transaction_count,
             requires_statespace=requires_statespace,
             iprof=iprof,
             enable_coverage_strategy=enable_coverage_strategy,
-            instruction_laser_plugin=instruction_laser_plugin,
+            instruction_laser_plugin=coverage_plugin,
         )
-
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
 
         plugin_loader = LaserPluginLoader(self.laser)
         plugin_loader.load(PluginFactory.build_mutation_pruner_plugin())
-        plugin_loader.load(instruction_laser_plugin)
+        plugin_loader.load(coverage_plugin)
         if not disable_dependency_pruning:
             plugin_loader.load(PluginFactory.build_dependency_pruner_plugin())
+
+        if run_analysis_modules:
+            detectors = ModuleLoader().get_detection_modules(
+                EntryPoint.CALLBACK, modules
+            )
+            for hook_type in ("pre", "post"):
+                self.laser.register_hooks(
+                    hook_type=hook_type,
+                    hook_dict=get_detection_module_hooks(detectors, hook_type),
+                )
 
         world_state = WorldState()
         for account in self.accounts.values():
             world_state.put_account(account)
 
-        if run_analysis_modules:
-            analysis_modules = ModuleLoader().get_detection_modules(
-                EntryPoint.CALLBACK, modules
-            )
-            self.laser.register_hooks(
-                hook_type="pre",
-                hook_dict=get_detection_module_hooks(analysis_modules, hook_type="pre"),
-            )
-            self.laser.register_hooks(
-                hook_type="post",
-                hook_dict=get_detection_module_hooks(analysis_modules, hook_type="post"),
-            )
+        self._execute(contract, address, world_state, dynloader)
 
-        if hasattr(contract, "creation_code") and contract.creation_code:
+        if requires_statespace:
+            self.nodes = self.laser.nodes
+            self.edges = self.laser.edges
+            self.calls = self._collect_calls()
+
+    # -- execution ------------------------------------------------------------
+
+    def _execute(self, contract, address, world_state, dynloader) -> None:
+        if getattr(contract, "creation_code", None):
             self.laser.sym_exec(
                 creation_code=contract.creation_code,
                 contract_name=contract.name,
                 world_state=world_state,
             )
-        else:
-            account = Account(
-                address,
-                contract.disassembly,
-                dynamic_loader=dynloader,
-                contract_name=contract.name,
-                balances=world_state.balances,
-                concrete_storage=True
-                if (dynloader is not None and dynloader.active)
-                else False,
-            )
-            if dynloader is not None and address.value is not None:
-                try:
-                    addr_hex = "{0:#0{1}x}".format(address.value, 42)
-                    account.set_balance(dynloader.read_balance(addr_hex))
-                except Exception:
-                    pass  # initial balance stays symbolic
-            world_state.put_account(account)
-            self.laser.sym_exec(world_state=world_state, target_address=address.value)
-
-        if not requires_statespace:
             return
+        target = Account(
+            address,
+            contract.disassembly,
+            dynamic_loader=dynloader,
+            contract_name=contract.name,
+            balances=world_state.balances,
+            concrete_storage=bool(dynloader is not None and dynloader.active),
+        )
+        if dynloader is not None and address.value is not None:
+            try:
+                target.set_balance(
+                    dynloader.read_balance("{0:#0{1}x}".format(address.value, 42))
+                )
+            except Exception:
+                pass  # initial balance stays symbolic
+        world_state.put_account(target)
+        self.laser.sym_exec(world_state=world_state, target_address=address.value)
 
-        self.nodes = self.laser.nodes
-        self.edges = self.laser.edges
+    # -- statespace post-pass ---------------------------------------------------
 
-        # parse calls for easy access by POST modules
-        self.calls: List[Call] = []
-        for key in self.nodes:
-            state_index = 0
-            for state in self.nodes[key].states:
-                instruction = state.get_current_instruction()
-                op = instruction["opcode"]
-                if op in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"):
-                    stack = state.mstate.stack
-                    if op in ("CALL", "CALLCODE"):
-                        gas, to, value, meminstart, meminsz = (
-                            get_variable(stack[-1]),
-                            get_variable(stack[-2]),
-                            get_variable(stack[-3]),
-                            get_variable(stack[-4]),
-                            get_variable(stack[-5]),
-                        )
-                        if to.type == VarType.CONCRETE and 0 < to.val <= PRECOMPILE_COUNT:
-                            continue  # ignore precompiles
-                        if (
-                            meminstart.type == VarType.CONCRETE
-                            and meminsz.type == VarType.CONCRETE
-                        ):
-                            self.calls.append(
-                                Call(
-                                    self.nodes[key],
-                                    state,
-                                    state_index,
-                                    op,
-                                    to,
-                                    gas,
-                                    value,
-                                    state.mstate.memory[
-                                        meminstart.val : meminsz.val + meminstart.val
-                                    ],
-                                )
-                            )
-                        else:
-                            self.calls.append(
-                                Call(self.nodes[key], state, state_index, op, to, gas, value)
-                            )
-                    else:
-                        gas, to = get_variable(stack[-1]), get_variable(stack[-2])
-                        self.calls.append(
-                            Call(self.nodes[key], state, state_index, op, to, gas)
-                        )
-                state_index += 1
+    def _collect_calls(self) -> List[Call]:
+        """Extract every CALL-family operation from the explored statespace
+        (the input POST modules scan)."""
+        calls: List[Call] = []
+        for node in self.nodes.values():
+            for state_index, state in enumerate(node.states):
+                opcode = state.get_current_instruction()["opcode"]
+                if opcode not in CALL_FAMILY:
+                    continue
+                call = self._parse_call(node, state, state_index, opcode)
+                if call is not None:
+                    calls.append(call)
+        return calls
+
+    @staticmethod
+    def _parse_call(node, state, state_index, opcode) -> Optional[Call]:
+        stack = state.mstate.stack
+        if opcode in ("DELEGATECALL", "STATICCALL"):
+            gas, to = get_variable(stack[-1]), get_variable(stack[-2])
+            return Call(node, state, state_index, opcode, to, gas)
+
+        gas = get_variable(stack[-1])
+        to = get_variable(stack[-2])
+        value = get_variable(stack[-3])
+        data_start = get_variable(stack[-4])
+        data_size = get_variable(stack[-5])
+        if to.type == VarType.CONCRETE and 0 < to.val <= PRECOMPILE_COUNT:
+            return None  # precompile targets aren't interesting calls
+        if data_start.type == VarType.CONCRETE and data_size.type == VarType.CONCRETE:
+            payload = state.mstate.memory[
+                data_start.val : data_start.val + data_size.val
+            ]
+            return Call(node, state, state_index, opcode, to, gas, value, payload)
+        return Call(node, state, state_index, opcode, to, gas, value)

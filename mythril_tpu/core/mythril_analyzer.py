@@ -1,10 +1,10 @@
-"""Analysis driver: per-contract symbolic execution -> Report.
+"""Analysis orchestration.
 
-Parity: mythril/mythril/mythril_analyzer.py:26 — fire_lasers loop
-(:129-193) with graceful per-contract degradation (crash or Ctrl-C
-salvages partial issues via retrieve_callback_issues and records the
-traceback in the report), plus dump_statespace and graph_html.
-"""
+Parity surface: mythril/mythril/mythril_analyzer.py (MythrilAnalyzer) —
+the per-contract loop above SymExecWrapper/fire_lasers: run each loaded
+contract, salvage partial results when a contract crashes or the user
+interrupts, attach source mappings, and assemble the Report. Also hosts
+the statespace-dump (-j) and CFG-graph (-g) commands."""
 
 import logging
 import traceback
@@ -57,6 +57,8 @@ class MythrilAnalyzer:
         analysis_args.set_loop_bound(loop_bound)
         analysis_args.set_solver_timeout(solver_timeout)
 
+    # -- shared plumbing --------------------------------------------------------
+
     def _make_dynloader(self):
         from mythril_tpu.support.loader import DynLoader
 
@@ -64,8 +66,24 @@ class MythrilAnalyzer:
             return None
         return DynLoader(self.eth, active=self.use_onchain_data)
 
+    def _wrapper_args(self, **overrides) -> dict:
+        """The SymExecWrapper keyword set every command shares."""
+        args = dict(
+            dynloader=self._make_dynloader(),
+            max_depth=self.max_depth,
+            execution_timeout=self.execution_timeout,
+            create_timeout=self.create_timeout,
+            disable_dependency_pruning=self.disable_dependency_pruning,
+            enable_coverage_strategy=self.enable_coverage_strategy,
+            custom_modules_directory=self.custom_modules_directory,
+        )
+        args.update(overrides)
+        return args
+
+    # -- commands -----------------------------------------------------------------
+
     def dump_statespace(self, contract: Optional[EVMContract] = None) -> str:
-        """Run symexec and serialize the statespace as JSON (`-j`)."""
+        """Serialize the explored statespace as JSON (`-j`)."""
         import json
 
         from mythril_tpu.analysis.traceexplore import get_serializable_statespace
@@ -74,14 +92,8 @@ class MythrilAnalyzer:
             contract or self.contracts[0],
             self.address,
             self.strategy,
-            dynloader=self._make_dynloader(),
-            max_depth=self.max_depth,
-            execution_timeout=self.execution_timeout,
-            create_timeout=self.create_timeout,
-            disable_dependency_pruning=self.disable_dependency_pruning,
             run_analysis_modules=False,
-            enable_coverage_strategy=self.enable_coverage_strategy,
-            custom_modules_directory=self.custom_modules_directory,
+            **self._wrapper_args(),
         )
         return json.dumps(get_serializable_statespace(sym))
 
@@ -99,15 +111,8 @@ class MythrilAnalyzer:
             contract or self.contracts[0],
             self.address,
             self.strategy,
-            dynloader=self._make_dynloader(),
-            max_depth=self.max_depth,
-            execution_timeout=self.execution_timeout,
-            transaction_count=transaction_count or 2,
-            create_timeout=self.create_timeout,
-            disable_dependency_pruning=self.disable_dependency_pruning,
             run_analysis_modules=False,
-            enable_coverage_strategy=self.enable_coverage_strategy,
-            custom_modules_directory=self.custom_modules_directory,
+            **self._wrapper_args(transaction_count=transaction_count or 2),
         )
         return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
 
@@ -121,37 +126,10 @@ class MythrilAnalyzer:
         source_data = Source()
         source_data.get_source_from_contracts_list(self.contracts)
         exceptions = []
+
         for contract in self.contracts:
-            StartTime()  # reset execution clock per contract
-            try:
-                sym = SymExecWrapper(
-                    contract,
-                    self.address,
-                    self.strategy,
-                    dynloader=self._make_dynloader(),
-                    max_depth=self.max_depth,
-                    execution_timeout=self.execution_timeout,
-                    loop_bound=self.loop_bound,
-                    create_timeout=self.create_timeout,
-                    transaction_count=transaction_count or 2,
-                    modules=modules,
-                    compulsory_statespace=False,
-                    iprof=self.iprof,
-                    disable_dependency_pruning=self.disable_dependency_pruning,
-                    enable_coverage_strategy=self.enable_coverage_strategy,
-                    custom_modules_directory=self.custom_modules_directory,
-                )
-                issues = fire_lasers(sym, modules)
-            except KeyboardInterrupt:
-                log.critical("Keyboard Interrupt")
-                issues = retrieve_callback_issues(modules)
-            except Exception:
-                log.critical(
-                    "Exception occurred, aborting analysis. Please report this issue.\n"
-                    + traceback.format_exc()
-                )
-                issues = retrieve_callback_issues(modules)
-                exceptions.append(traceback.format_exc())
+            StartTime()  # reset the execution clock per contract
+            issues = self._analyze_one(contract, modules, transaction_count, exceptions)
             for issue in issues:
                 issue.add_code_info(contract)
             all_issues += issues
@@ -163,3 +141,31 @@ class MythrilAnalyzer:
         for issue in all_issues:
             report.append_issue(issue)
         return report
+
+    def _analyze_one(
+        self, contract, modules, transaction_count, exceptions
+    ) -> List[Issue]:
+        """One contract through symexec + detectors, with salvage paths."""
+        try:
+            sym = SymExecWrapper(
+                contract,
+                self.address,
+                self.strategy,
+                loop_bound=self.loop_bound,
+                transaction_count=transaction_count or 2,
+                modules=modules,
+                compulsory_statespace=False,
+                iprof=self.iprof,
+                **self._wrapper_args(),
+            )
+            return fire_lasers(sym, modules)
+        except KeyboardInterrupt:
+            log.critical("Keyboard Interrupt")
+            return retrieve_callback_issues(modules)
+        except Exception:
+            log.critical(
+                "Exception occurred, aborting analysis. Please report this issue.\n"
+                + traceback.format_exc()
+            )
+            exceptions.append(traceback.format_exc())
+            return retrieve_callback_issues(modules)

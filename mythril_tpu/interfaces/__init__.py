@@ -1,0 +1,4 @@
+"""External interfaces: the `myth` CLI (cli.py).
+
+Reference surface: mythril/interfaces/ (cli.py console entry point).
+"""

@@ -1,0 +1,354 @@
+"""`myth` command line interface.
+
+Parity surface: mythril/interfaces/cli.py (the reference's 827-line argparse
+tree). This is a re-design, not a port: a declarative command registry maps
+subcommand names to (argument-builder, runner) pairs, shared flag groups are
+composed per command, and all output formatting funnels through one
+``emit_report`` sink so text/markdown/json/jsonv2 stay consistent.
+
+Subcommands:
+  analyze (a)         symbolic-execution security analysis
+  disassemble (d)     bytecode -> assembly listing
+  list-detectors      registered detection modules
+  version             package version
+  function-to-hash    4-byte selector for a signature
+  hash-to-address     last 20 bytes of a 32-byte hash as an address
+  read-storage        read storage slots over RPC
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Tuple
+
+from mythril_tpu import __version__
+from mythril_tpu.exceptions import CriticalError
+
+log = logging.getLogger(__name__)
+
+JSON_ERROR_OUTFORMS = ("json", "jsonv2")
+
+
+def exit_with_error(outform: str, message: str) -> None:
+    """Print an error in the requested format and exit(1)."""
+    if outform == "json":
+        print(json.dumps({"success": False, "error": message, "issues": []}))
+    elif outform == "jsonv2":
+        print(json.dumps([{"issues": [], "meta": {"logs": [{"level": "error", "hidden": True, "msg": message}]}}]))
+    else:
+        print(message, file=sys.stderr)
+    sys.exit(1)
+
+
+# --------------------------------------------------------------- flag groups
+
+
+def add_input_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("input")
+    group.add_argument("solidity_files", nargs="*", help=".sol files (suffix :ContractName to select one contract)")
+    group.add_argument("-f", "--codefile", type=argparse.FileType("r"), help="file containing hex-encoded bytecode")
+    group.add_argument("-c", "--code", help="hex-encoded creation bytecode string")
+    group.add_argument("--bin-runtime", action="store_true", help="treat -c/-f input as runtime bytecode")
+    group.add_argument("-a", "--address", help="on-chain contract address to load over RPC")
+    group.add_argument("--solc-json", help="solc standard-json settings file")
+    group.add_argument("--solv", help="solc version to use (requires matching binary on PATH)")
+
+
+def add_rpc_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("networking")
+    group.add_argument("--rpc", metavar="HOST:PORT / ganache / infura-<net>", help="custom RPC settings")
+    group.add_argument("--rpctls", type=bool, default=False, help="RPC connection over TLS")
+    group.add_argument("--infura-id", help="infura project id")
+
+
+def add_output_flag(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "-o", "--outform", choices=("text", "markdown", "json", "jsonv2"), default="text",
+        help="report output format",
+    )
+
+
+def add_analysis_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("analysis")
+    group.add_argument(
+        "--strategy",
+        choices=("dfs", "bfs", "naive-random", "weighted-random", "tpu-batch"),
+        default="bfs",
+        help="search strategy (tpu-batch = batched device backend)",
+    )
+    group.add_argument("-t", "--transaction-count", type=int, default=2, help="transaction depth")
+    group.add_argument("-b", "--loop-bound", type=int, default=3, metavar="N", help="bound loops to N iterations")
+    group.add_argument("--max-depth", type=int, default=128, help="maximum instruction depth per path")
+    group.add_argument("--execution-timeout", type=int, default=86400, metavar="SEC", help="total symbolic execution budget")
+    group.add_argument("--create-timeout", type=int, default=10, metavar="SEC", help="creation-transaction budget")
+    group.add_argument("--solver-timeout", type=int, default=10000, metavar="MS", help="per-query solver budget")
+    group.add_argument("-m", "--modules", metavar="MODULES", help="comma-separated detection module whitelist")
+    group.add_argument("--no-onchain-data", action="store_true", help="never load code/storage over RPC")
+    group.add_argument("-g", "--graph", metavar="HTML_FILE", help="write an interactive CFG graph")
+    group.add_argument("-j", "--statespace-json", metavar="JSON_FILE", help="dump the explored statespace")
+    group.add_argument("--enable-iprof", action="store_true", help="per-opcode instruction profiler")
+    group.add_argument("--disable-dependency-pruning", action="store_true")
+    group.add_argument("--enable-coverage-strategy", action="store_true")
+    group.add_argument("--custom-modules-directory", default="", help="extra detection modules directory")
+    group.add_argument("-q", "--query-signature", action="store_true", help="look up selectors on 4byte.directory")
+    group.add_argument("--lanes", type=int, default=None, help="tpu-batch: device lanes per round")
+
+
+# ------------------------------------------------------------------ plumbing
+
+
+def _make_config(args):
+    from mythril_tpu.core.mythril_config import MythrilConfig
+
+    config = MythrilConfig()
+    if getattr(args, "infura_id", None):
+        config.set_api_infura_id(args.infura_id)
+    if getattr(args, "address", None) or getattr(args, "command", "") == "read-storage":
+        rpc = getattr(args, "rpc", None)
+        if rpc:
+            config.set_api_rpc(rpc, getattr(args, "rpctls", False))
+        else:
+            config.set_api_rpc_infura()
+    return config
+
+
+def _make_disassembler(args, config):
+    from mythril_tpu.core.mythril_disassembler import MythrilDisassembler
+
+    return MythrilDisassembler(
+        eth=config.eth,
+        solc_version=getattr(args, "solv", None),
+        solc_settings_json=getattr(args, "solc_json", None),
+        enable_online_lookup=getattr(args, "query_signature", False),
+    )
+
+
+def _load_code(args, disassembler) -> str:
+    """Load the analysis target; returns the target address."""
+    if args.code:
+        address, _ = disassembler.load_from_bytecode(args.code, args.bin_runtime)
+    elif args.codefile:
+        bytecode = "".join([l.strip() for l in args.codefile if len(l.strip()) > 0])
+        address, _ = disassembler.load_from_bytecode(bytecode, args.bin_runtime)
+    elif args.address:
+        address, _ = disassembler.load_from_address(args.address)
+    elif args.solidity_files:
+        address, _ = disassembler.load_from_solidity(args.solidity_files)
+    else:
+        raise CriticalError(
+            "No input bytecode. Please provide EVM code via -c BYTECODE, -a ADDRESS, -f BYTECODE_FILE or a Solidity file"
+        )
+    return address
+
+
+# ------------------------------------------------------------------ commands
+
+
+def run_analyze(args) -> None:
+    from mythril_tpu.core.mythril_analyzer import MythrilAnalyzer
+
+    if args.lanes:
+        import mythril_tpu.laser.tpu.backend as backend
+
+        backend.DEFAULT_BATCH_CFG = backend.DEFAULT_BATCH_CFG._replace(lanes=args.lanes)
+
+    config = _make_config(args)
+    disassembler = _make_disassembler(args, config)
+    address = _load_code(args, disassembler)
+    analyzer = MythrilAnalyzer(
+        disassembler,
+        strategy=args.strategy,
+        address=address,
+        max_depth=args.max_depth,
+        execution_timeout=args.execution_timeout,
+        loop_bound=args.loop_bound,
+        create_timeout=args.create_timeout,
+        enable_iprof=args.enable_iprof,
+        disable_dependency_pruning=args.disable_dependency_pruning,
+        solver_timeout=args.solver_timeout,
+        enable_coverage_strategy=args.enable_coverage_strategy,
+        custom_modules_directory=args.custom_modules_directory,
+        use_onchain_data=not args.no_onchain_data,
+    )
+
+    if args.graph:
+        html = analyzer.graph_html(transaction_count=args.transaction_count)
+        with open(args.graph, "w") as f:
+            f.write(html)
+        return
+    if args.statespace_json:
+        dump = analyzer.dump_statespace()
+        with open(args.statespace_json, "w") as f:
+            f.write(dump)
+        return
+
+    modules = args.modules.split(",") if args.modules else None
+    report = analyzer.fire_lasers(
+        modules=modules, transaction_count=args.transaction_count
+    )
+    emit_report(report, args.outform)
+
+
+def emit_report(report, outform: str) -> None:
+    renderers: Dict[str, Callable[[], str]] = {
+        "text": report.as_text,
+        "markdown": report.as_markdown,
+        "json": report.as_json,
+        "jsonv2": report.as_swc_standard_format,
+    }
+    print(renderers[outform]())
+
+
+def run_disassemble(args) -> None:
+    config = _make_config(args)
+    disassembler = _make_disassembler(args, config)
+    _load_code(args, disassembler)
+    contract = disassembler.contracts[0]
+    listing = contract.get_easm()
+    if listing:
+        print("Runtime Disassembly:\n" + listing)
+    creation = getattr(contract, "creation_disassembly", None)
+    if creation is not None and getattr(creation, "instruction_list", None):
+        from mythril_tpu.disassembler.asm import instruction_list_to_easm
+
+        print("Creation Disassembly:\n" + instruction_list_to_easm(creation.instruction_list))
+    elif not listing:
+        raise CriticalError("No code to disassemble")
+
+
+def run_list_detectors(args) -> None:
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    modules = []
+    for module in ModuleLoader().get_detection_modules():
+        modules.append({"classname": type(module).__name__, "title": module.name})
+    if args.outform in ("json", "jsonv2"):
+        print(json.dumps(modules))
+    else:
+        for module_data in modules:
+            print("{}: {}".format(module_data["classname"], module_data["title"]))
+
+
+def run_version(args) -> None:
+    if args.outform in ("json", "jsonv2"):
+        print(json.dumps({"version_str": "v" + __version__}))
+    else:
+        print("Mythril-TPU version v{}".format(__version__))
+
+
+def run_function_to_hash(args) -> None:
+    from mythril_tpu.core.mythril_disassembler import MythrilDisassembler
+
+    print(MythrilDisassembler.hash_for_function_signature(args.func))
+
+
+def run_hash_to_address(args) -> None:
+    value = args.hash
+    if value.startswith("0x"):
+        value = value[2:]
+    if len(value) != 64:
+        raise CriticalError("Invalid hash. Expected a 32-byte hex string")
+    print("0x" + value[-40:])
+
+
+def run_read_storage(args) -> None:
+    config = _make_config(args)
+    disassembler = _make_disassembler(args, config)
+    outtxt = disassembler.get_state_variable_from_storage(
+        address=args.address, params=args.storage_slots.split(",")
+    )
+    print(outtxt)
+
+
+# ------------------------------------------------------------------ registry
+
+COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
+    # name: (help, [flag group builders], runner)
+    "analyze": (
+        "Triggers the symbolic-execution security analysis",
+        [add_input_flags, add_rpc_flags, add_output_flag, add_analysis_flags],
+        run_analyze,
+    ),
+    "disassemble": (
+        "Disassembles the input bytecode",
+        [add_input_flags, add_rpc_flags, add_output_flag],
+        run_disassemble,
+    ),
+    "list-detectors": (
+        "Lists the available detection modules",
+        [add_output_flag],
+        run_list_detectors,
+    ),
+    "version": ("Prints the version", [add_output_flag], run_version),
+    "function-to-hash": (
+        "4-byte selector for a function signature",
+        [lambda p: p.add_argument("func", help="signature, e.g. 'transfer(address,uint256)'")],
+        run_function_to_hash,
+    ),
+    "hash-to-address": (
+        "Address form of a 32-byte hash",
+        [lambda p: p.add_argument("hash", help="32-byte hex hash")],
+        run_hash_to_address,
+    ),
+    "read-storage": (
+        "Read state variables from on-chain storage",
+        [
+            lambda p: p.add_argument("storage_slots", help="position[,length] or mapping math"),
+            lambda p: p.add_argument("address", help="contract address"),
+            add_rpc_flags,
+            add_output_flag,
+        ],
+        run_read_storage,
+    ),
+}
+
+ALIASES = {"a": "analyze", "d": "disassemble"}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="myth",
+        description="Mythril-TPU: security analysis of EVM bytecode on TPU",
+    )
+    parser.add_argument("--version", action="version", version="v" + __version__)
+    parser.add_argument("-v", metavar="LOG_LEVEL", type=int, default=2, dest="verbosity",
+                        help="log level 0 (silent) .. 5 (trace)")
+    subparsers = parser.add_subparsers(dest="command")
+    for name, (help_text, flag_builders, _runner) in COMMANDS.items():
+        aliases = [a for a, target in ALIASES.items() if target == name]
+        sub = subparsers.add_parser(name, help=help_text, aliases=aliases)
+        for builder in flag_builders:
+            builder(sub)
+    return parser
+
+
+def _set_verbosity(level: int) -> None:
+    levels = {
+        0: logging.CRITICAL, 1: logging.ERROR, 2: logging.WARNING,
+        3: logging.INFO, 4: logging.DEBUG, 5: logging.DEBUG,
+    }
+    logging.basicConfig(level=levels.get(level, logging.WARNING))
+    logging.getLogger("jax").setLevel(logging.ERROR)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    command = ALIASES.get(args.command, args.command)
+    if command is None:
+        parser.print_help()
+        sys.exit(2)
+    _set_verbosity(args.verbosity)
+    outform = getattr(args, "outform", "text")
+    try:
+        COMMANDS[command][2](args)
+    except CriticalError as e:
+        exit_with_error(outform, str(e))
+    except KeyboardInterrupt:
+        exit_with_error(outform, "Analysis was interrupted")
+
+
+if __name__ == "__main__":
+    main()

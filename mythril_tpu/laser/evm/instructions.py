@@ -217,780 +217,6 @@ class Instruction:
         global_state.mstate.stack.pop()
         return [global_state]
 
-    # -- bitwise --------------------------------------------------------------
-
-    @StateTransition()
-    def and_(self, global_state: GlobalState) -> List[GlobalState]:
-        stack = global_state.mstate.stack
-        op1, op2 = _as_bitvec(stack.pop()), _as_bitvec(stack.pop())
-        stack.append(op1 & op2)
-        return [global_state]
-
-    @StateTransition()
-    def or_(self, global_state: GlobalState) -> List[GlobalState]:
-        stack = global_state.mstate.stack
-        op1, op2 = _as_bitvec(stack.pop()), _as_bitvec(stack.pop())
-        stack.append(op1 | op2)
-        return [global_state]
-
-    @StateTransition()
-    def xor_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(util.pop_bitvec(mstate) ^ util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def not_(self, global_state: GlobalState):
-        mstate = global_state.mstate
-        mstate.stack.append(symbol_factory.BitVecVal(TT256M1, 256) - util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def byte_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        op0, op1 = mstate.stack.pop(), mstate.stack.pop()
-        if not isinstance(op1, Expression):
-            op1 = symbol_factory.BitVecVal(op1, 256)
-        try:
-            index = util.get_concrete_int(op0)
-            offset = (31 - index) * 8
-            if offset >= 0:
-                result: Union[int, Expression] = simplify(
-                    Concat(
-                        symbol_factory.BitVecVal(0, 248),
-                        Extract(offset + 7, offset, op1),
-                    )
-                )
-            else:
-                result = 0
-        except TypeError:
-            log.debug("BYTE: Unsupported symbolic byte offset")
-            result = global_state.new_bitvec(
-                str(simplify(op1)) + "[" + str(simplify(op0)) + "]", 256
-            )
-        mstate.stack.append(result)
-        return [global_state]
-
-    # -- arithmetic -----------------------------------------------------------
-
-    @StateTransition()
-    def add_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(util.pop_bitvec(mstate) + util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def sub_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(util.pop_bitvec(mstate) - util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def mul_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(util.pop_bitvec(mstate) * util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def div_(self, global_state: GlobalState) -> List[GlobalState]:
-        op0, op1 = util.pop_bitvec(global_state.mstate), util.pop_bitvec(global_state.mstate)
-        if op1.value == 0:
-            global_state.mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        elif op1.symbolic:
-            global_state.mstate.stack.append(
-                If(op1 == 0, symbol_factory.BitVecVal(0, 256), UDiv(op0, op1))
-            )
-        else:
-            global_state.mstate.stack.append(UDiv(op0, op1))
-        return [global_state]
-
-    @StateTransition()
-    def sdiv_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1 = util.pop_bitvec(global_state.mstate), util.pop_bitvec(global_state.mstate)
-        if s1.value == 0:
-            global_state.mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        elif s1.symbolic:
-            global_state.mstate.stack.append(
-                If(s1 == 0, symbol_factory.BitVecVal(0, 256), s0 / s1)
-            )
-        else:
-            global_state.mstate.stack.append(s0 / s1)
-        return [global_state]
-
-    @StateTransition()
-    def mod_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1 = util.pop_bitvec(global_state.mstate), util.pop_bitvec(global_state.mstate)
-        if s1.value == 0:
-            global_state.mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        elif s1.symbolic:
-            global_state.mstate.stack.append(
-                If(s1 == 0, symbol_factory.BitVecVal(0, 256), URem(s0, s1))
-            )
-        else:
-            global_state.mstate.stack.append(URem(s0, s1))
-        return [global_state]
-
-    @StateTransition()
-    def smod_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1 = util.pop_bitvec(global_state.mstate), util.pop_bitvec(global_state.mstate)
-        if s1.value == 0:
-            global_state.mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        elif s1.symbolic:
-            global_state.mstate.stack.append(
-                If(s1 == 0, symbol_factory.BitVecVal(0, 256), SRem(s0, s1))
-            )
-        else:
-            global_state.mstate.stack.append(SRem(s0, s1))
-        return [global_state]
-
-    @StateTransition()
-    def shl_(self, global_state: GlobalState) -> List[GlobalState]:
-        shift, value = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(value << shift)
-        return [global_state]
-
-    @StateTransition()
-    def shr_(self, global_state: GlobalState) -> List[GlobalState]:
-        shift, value = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(LShR(value, shift))
-        return [global_state]
-
-    @StateTransition()
-    def sar_(self, global_state: GlobalState) -> List[GlobalState]:
-        shift, value = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(value >> shift)
-        return [global_state]
-
-    @StateTransition()
-    def addmod_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        s0, s1, s2 = (
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-        )
-        if s2.value == 0:
-            mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        elif s2.symbolic:
-            mstate.stack.append(
-                If(
-                    s2 == 0,
-                    symbol_factory.BitVecVal(0, 256),
-                    URem(URem(s0, s2) + URem(s1, s2), s2),
-                )
-            )
-        else:
-            # widen to 257 bits so the intermediate sum cannot wrap
-            from mythril_tpu.smt import ZeroExt
-
-            wide = URem(
-                cast(BitVec, ZeroExt(1, URem(s0, s2)) + ZeroExt(1, URem(s1, s2))),
-                ZeroExt(1, s2),
-            )
-            mstate.stack.append(Extract(255, 0, wide))
-        return [global_state]
-
-    @StateTransition()
-    def mulmod_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        s0, s1, s2 = (
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-        )
-        if s2.value == 0:
-            mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        elif s2.symbolic:
-            mstate.stack.append(
-                If(
-                    s2 == 0,
-                    symbol_factory.BitVecVal(0, 256),
-                    URem(URem(s0, s2) * URem(s1, s2), s2),
-                )
-            )
-        else:
-            from mythril_tpu.smt import ZeroExt
-
-            wide = URem(
-                cast(BitVec, ZeroExt(256, URem(s0, s2)) * ZeroExt(256, URem(s1, s2))),
-                ZeroExt(256, s2),
-            )
-            mstate.stack.append(Extract(255, 0, wide))
-        return [global_state]
-
-    @StateTransition()
-    def exp_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        base, exponent = util.pop_bitvec(state), util.pop_bitvec(state)
-        if base.symbolic or exponent.symbolic:
-            state.stack.append(
-                global_state.new_bitvec(
-                    "invhash(" + str(hash(simplify(base))) + ")**invhash("
-                    + str(hash(simplify(exponent))) + ")",
-                    256,
-                    base.annotations.union(exponent.annotations),
-                )
-            )
-        else:
-            state.stack.append(
-                symbol_factory.BitVecVal(
-                    pow(base.value, exponent.value, 2**256),
-                    256,
-                    annotations=base.annotations.union(exponent.annotations),
-                )
-            )
-        return [global_state]
-
-    @StateTransition()
-    def signextend_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        s0, s1 = mstate.stack.pop(), mstate.stack.pop()
-        try:
-            s0 = util.get_concrete_int(s0)
-            s1 = util.get_concrete_int(s1)
-        except TypeError:
-            log.debug("Unsupported symbolic argument for SIGNEXTEND")
-            mstate.stack.append(
-                global_state.new_bitvec("SIGNEXTEND({},{})".format(hash(s0), hash(s1)), 256)
-            )
-            return [global_state]
-        if s0 <= 31:
-            testbit = s0 * 8 + 7
-            if s1 & (1 << testbit):
-                mstate.stack.append(s1 | (TT256 - (1 << testbit)))
-            else:
-                mstate.stack.append(s1 & ((1 << testbit) - 1))
-        else:
-            mstate.stack.append(s1)
-        return [global_state]
-
-    # -- comparisons ----------------------------------------------------------
-
-    @StateTransition()
-    def lt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        state.stack.append(ULT(util.pop_bitvec(state), util.pop_bitvec(state)))
-        return [global_state]
-
-    @StateTransition()
-    def gt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op1, op2 = util.pop_bitvec(state), util.pop_bitvec(state)
-        state.stack.append(UGT(op1, op2))
-        return [global_state]
-
-    @StateTransition()
-    def slt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        state.stack.append(util.pop_bitvec(state) < util.pop_bitvec(state))
-        return [global_state]
-
-    @StateTransition()
-    def sgt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        state.stack.append(util.pop_bitvec(state) > util.pop_bitvec(state))
-        return [global_state]
-
-    @StateTransition()
-    def eq_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op1, op2 = _as_bitvec(state.stack.pop()), _as_bitvec(state.stack.pop())
-        state.stack.append(op1 == op2)
-        return [global_state]
-
-    @StateTransition()
-    def iszero_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        val = state.stack.pop()
-        exp = Not(val) if isinstance(val, Bool) else val == 0
-        exp = If(exp, symbol_factory.BitVecVal(1, 256), symbol_factory.BitVecVal(0, 256))
-        state.stack.append(simplify(exp))
-        return [global_state]
-
-    # -- call data ------------------------------------------------------------
-
-    @StateTransition()
-    def callvalue_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.callvalue)
-        return [global_state]
-
-    @StateTransition()
-    def calldataload_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op0 = state.stack.pop()
-        value = global_state.environment.calldata.get_word_at(op0)
-        state.stack.append(value)
-        return [global_state]
-
-    @StateTransition()
-    def calldatasize_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            log.debug("Attempt to use CALLDATASIZE in creation transaction")
-            state.stack.append(0)
-        else:
-            state.stack.append(global_state.environment.calldata.calldatasize)
-        return [global_state]
-
-    @staticmethod
-    def _calldata_copy_helper(global_state, mstate, mstart, dstart, size):
-        environment = global_state.environment
-        try:
-            mstart = util.get_concrete_int(mstart)
-        except TypeError:
-            log.debug("Unsupported symbolic memory offset in CALLDATACOPY")
-            return [global_state]
-        try:
-            dstart = util.get_concrete_int(dstart)
-        except TypeError:
-            log.debug("Unsupported symbolic calldata offset in CALLDATACOPY")
-            dstart = simplify(dstart)
-        try:
-            size = util.get_concrete_int(size)
-        except TypeError:
-            log.debug("Unsupported symbolic size in CALLDATACOPY")
-            size = 320  # excess gets overwritten
-        if size > 0:
-            try:
-                mstate.mem_extend(mstart, size)
-            except TypeError as e:
-                log.debug("Memory allocation error: %s", e)
-                mstate.mem_extend(mstart, 1)
-                mstate.memory[mstart] = global_state.new_bitvec(
-                    "calldata_" + str(environment.active_account.contract_name)
-                    + "[" + str(dstart) + ": + " + str(size) + "]",
-                    8,
-                )
-                return [global_state]
-            try:
-                i_data = dstart
-                new_memory = []
-                for i in range(size):
-                    new_memory.append(environment.calldata[i_data])
-                    i_data = (
-                        i_data + 1
-                        if isinstance(i_data, int)
-                        else simplify(cast(BitVec, i_data) + 1)
-                    )
-                for i in range(len(new_memory)):
-                    mstate.memory[i + mstart] = new_memory[i]
-            except IndexError:
-                log.debug("Exception copying calldata to memory")
-                mstate.memory[mstart] = global_state.new_bitvec(
-                    "calldata_" + str(environment.active_account.contract_name)
-                    + "[" + str(dstart) + ": + " + str(size) + "]",
-                    8,
-                )
-        return [global_state]
-
-    @StateTransition()
-    def calldatacopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op0, op1, op2 = state.stack.pop(), state.stack.pop(), state.stack.pop()
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            log.debug("Attempt to use CALLDATACOPY in creation transaction")
-            return [global_state]
-        return self._calldata_copy_helper(global_state, state, op0, op1, op2)
-
-    # -- environment ----------------------------------------------------------
-
-    @StateTransition()
-    def address_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.address)
-        return [global_state]
-
-    @StateTransition()
-    def balance_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        address = state.stack.pop()
-        if isinstance(address, BitVec) and address.value is not None and self.dynamic_loader:
-            try:
-                account = global_state.world_state.accounts_exist_or_load(
-                    address.value, self.dynamic_loader
-                )
-                state.stack.append(account.balance())
-                return [global_state]
-            except (ValueError, AttributeError):
-                pass
-        # balances array handles both known and symbolic addresses
-        state.stack.append(global_state.world_state.balances[_as_bitvec(address)])
-        return [global_state]
-
-    @StateTransition()
-    def origin_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.origin)
-        return [global_state]
-
-    @StateTransition()
-    def caller_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.sender)
-        return [global_state]
-
-    @StateTransition()
-    def chainid_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.chainid)
-        return [global_state]
-
-    @StateTransition()
-    def selfbalance_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.active_account.balance())
-        return [global_state]
-
-    @StateTransition()
-    def codesize_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        environment = global_state.environment
-        disassembly = environment.code
-        calldata = environment.calldata
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            # creation code followed by constructor arguments
-            no_of_bytes = len(disassembly.bytecode) // 2
-            if isinstance(calldata, ConcreteCalldata):
-                no_of_bytes += calldata.size
-            else:
-                no_of_bytes += 0x200  # space for 16 32-byte arguments
-                global_state.world_state.constraints.append(
-                    environment.calldata.calldatasize == no_of_bytes
-                )
-        else:
-            no_of_bytes = len(disassembly.bytecode) // 2
-        state.stack.append(no_of_bytes)
-        return [global_state]
-
-    @staticmethod
-    def _sha3_gas_helper(global_state, length):
-        min_gas, max_gas = calculate_sha3_gas(length)
-        global_state.mstate.min_gas_used += min_gas
-        global_state.mstate.max_gas_used += max_gas
-        StateTransition.check_gas_usage_limit(global_state)
-        return global_state
-
-    @StateTransition(enable_gas=False)
-    def sha3_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op0, op1 = state.stack.pop(), state.stack.pop()
-        try:
-            index, length = util.get_concrete_int(op0), util.get_concrete_int(op1)
-        except TypeError:
-            # symbolic memory offset
-            if isinstance(op0, Expression):
-                op0 = simplify(op0)
-            state.stack.append(
-                symbol_factory.BitVecSym("KECCAC_mem[{}]".format(hash(op0)), 256)
-            )
-            gas_tuple = get_opcode_gas("SHA3")
-            state.min_gas_used += gas_tuple[0]
-            state.max_gas_used += gas_tuple[1]
-            return [global_state]
-
-        Instruction._sha3_gas_helper(global_state, length)
-        state.mem_extend(index, length)
-        data_list = [
-            b if isinstance(b, BitVec) else symbol_factory.BitVecVal(b, 8)
-            for b in state.memory[index : index + length]
-        ]
-        if len(data_list) > 1:
-            data = simplify(Concat(data_list))
-        elif len(data_list) == 1:
-            data = data_list[0]
-        else:
-            result = keccak_function_manager.get_empty_keccak_hash()
-            state.stack.append(result)
-            return [global_state]
-
-        result, condition = keccak_function_manager.create_keccak(data)
-        state.stack.append(result)
-        global_state.world_state.constraints.append(condition)
-        return [global_state]
-
-    @StateTransition()
-    def gasprice_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.gasprice)
-        return [global_state]
-
-    @staticmethod
-    def _code_copy_helper(code, memory_offset, code_offset, size, op, global_state) -> List[GlobalState]:
-        try:
-            concrete_memory_offset = util.get_concrete_int(memory_offset)
-        except TypeError:
-            log.debug("Unsupported symbolic memory offset in %s", op)
-            return [global_state]
-        try:
-            concrete_size = util.get_concrete_int(size)
-            global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
-        except TypeError:
-            global_state.mstate.mem_extend(concrete_memory_offset, 1)
-            global_state.mstate.memory[concrete_memory_offset] = global_state.new_bitvec(
-                "code({})".format(global_state.environment.active_account.contract_name), 8
-            )
-            return [global_state]
-        try:
-            concrete_code_offset = util.get_concrete_int(code_offset)
-        except TypeError:
-            log.debug("Unsupported symbolic code offset in %s", op)
-            global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
-            for i in range(concrete_size):
-                global_state.mstate.memory[concrete_memory_offset + i] = global_state.new_bitvec(
-                    "code({})".format(global_state.environment.active_account.contract_name), 8
-                )
-            return [global_state]
-        if code[0:2] == "0x":
-            code = code[2:]
-        for i in range(concrete_size):
-            if 2 * (concrete_code_offset + i + 1) > len(code):
-                break
-            global_state.mstate.memory[concrete_memory_offset + i] = int(
-                code[2 * (concrete_code_offset + i) : 2 * (concrete_code_offset + i + 1)], 16
-            )
-        return [global_state]
-
-    @StateTransition()
-    def codecopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        memory_offset, code_offset, size = (
-            global_state.mstate.stack.pop(),
-            global_state.mstate.stack.pop(),
-            global_state.mstate.stack.pop(),
-        )
-        code = global_state.environment.code.bytecode
-        if code[0:2] == "0x":
-            code = code[2:]
-        code_size = len(code) // 2
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            # creation code is followed by constructor arguments (modeled as
-            # calldata); copies past the code end read from there
-            mstate = global_state.mstate
-            offset = code_offset - code_size
-            if isinstance(global_state.environment.calldata, SymbolicCalldata):
-                if code_offset >= code_size:
-                    return self._calldata_copy_helper(
-                        global_state, mstate, memory_offset, offset, size
-                    )
-            else:
-                concrete_code_offset = util.get_concrete_int(code_offset)
-                concrete_size = util.get_concrete_int(size)
-                code_copy_offset = concrete_code_offset
-                code_copy_size = (
-                    concrete_size
-                    if concrete_code_offset + concrete_size <= code_size
-                    else code_size - concrete_code_offset
-                )
-                code_copy_size = code_copy_size if code_copy_size >= 0 else 0
-                calldata_copy_offset = (
-                    concrete_code_offset - code_size
-                    if concrete_code_offset - code_size > 0
-                    else 0
-                )
-                calldata_copy_size = concrete_code_offset + concrete_size - code_size
-                calldata_copy_size = calldata_copy_size if calldata_copy_size >= 0 else 0
-                [global_state] = self._code_copy_helper(
-                    code=global_state.environment.code.bytecode,
-                    memory_offset=memory_offset,
-                    code_offset=code_copy_offset,
-                    size=code_copy_size,
-                    op="CODECOPY",
-                    global_state=global_state,
-                )
-                return self._calldata_copy_helper(
-                    global_state=global_state,
-                    mstate=mstate,
-                    mstart=memory_offset + code_copy_size,
-                    dstart=calldata_copy_offset,
-                    size=calldata_copy_size,
-                )
-        return self._code_copy_helper(
-            code=global_state.environment.code.bytecode,
-            memory_offset=memory_offset,
-            code_offset=code_offset,
-            size=size,
-            op="CODECOPY",
-            global_state=global_state,
-        )
-
-    @StateTransition()
-    def extcodesize_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        addr = state.stack.pop()
-        try:
-            addr = hex(util.get_concrete_int(addr))
-        except TypeError:
-            log.debug("unsupported symbolic address for EXTCODESIZE")
-            state.stack.append(global_state.new_bitvec("extcodesize_" + str(addr), 256))
-            return [global_state]
-        try:
-            code = global_state.world_state.accounts_exist_or_load(
-                addr, self.dynamic_loader
-            ).code.bytecode
-        except (ValueError, AttributeError) as e:
-            log.debug("error accessing contract storage due to: %s", e)
-            state.stack.append(global_state.new_bitvec("extcodesize_" + str(addr), 256))
-            return [global_state]
-        state.stack.append(len(code) // 2)
-        return [global_state]
-
-    @StateTransition()
-    def extcodecopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        addr, memory_offset, code_offset, size = (
-            state.stack.pop(),
-            state.stack.pop(),
-            state.stack.pop(),
-            state.stack.pop(),
-        )
-        try:
-            addr = hex(util.get_concrete_int(addr))
-        except TypeError:
-            log.debug("unsupported symbolic address for EXTCODECOPY")
-            return [global_state]
-        try:
-            code = global_state.world_state.accounts_exist_or_load(
-                addr, self.dynamic_loader
-            ).code.bytecode
-        except (ValueError, AttributeError) as e:
-            log.debug("error accessing contract storage due to: %s", e)
-            return [global_state]
-        return self._code_copy_helper(
-            code=code,
-            memory_offset=memory_offset,
-            code_offset=code_offset,
-            size=size,
-            op="EXTCODECOPY",
-            global_state=global_state,
-        )
-
-    @StateTransition()
-    def extcodehash_(self, global_state: GlobalState) -> List[GlobalState]:
-        world_state = global_state.world_state
-        stack = global_state.mstate.stack
-        address = Extract(159, 0, stack.pop())
-        if address.symbolic:
-            code_hash = symbol_factory.BitVecVal(int(get_code_hash(""), 16), 256)
-        elif address.value not in world_state.accounts:
-            code_hash = symbol_factory.BitVecVal(0, 256)
-        else:
-            addr = "0" * (40 - len(hex(address.value)[2:])) + hex(address.value)[2:]
-            code = world_state.accounts_exist_or_load(addr, self.dynamic_loader).code.bytecode
-            code_hash = symbol_factory.BitVecVal(int(get_code_hash(code), 16), 256)
-        stack.append(code_hash)
-        return [global_state]
-
-    @StateTransition()
-    def returndatacopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        memory_offset, return_offset, size = (
-            state.stack.pop(),
-            state.stack.pop(),
-            state.stack.pop(),
-        )
-        try:
-            concrete_memory_offset = util.get_concrete_int(memory_offset)
-            concrete_return_offset = util.get_concrete_int(return_offset)
-            concrete_size = util.get_concrete_int(size)
-        except TypeError:
-            log.debug("Unsupported symbolic argument in RETURNDATACOPY")
-            return [global_state]
-        if global_state.last_return_data is None:
-            return [global_state]
-        global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
-        for i in range(concrete_size):
-            global_state.mstate.memory[concrete_memory_offset + i] = (
-                global_state.last_return_data[concrete_return_offset + i]
-                if concrete_return_offset + i < len(global_state.last_return_data)
-                else 0
-            )
-        return [global_state]
-
-    @StateTransition()
-    def returndatasize_(self, global_state: GlobalState) -> List[GlobalState]:
-        if global_state.last_return_data is None:
-            log.debug("No last_return_data found, adding an unconstrained bitvec")
-            global_state.mstate.stack.append(global_state.new_bitvec("returndatasize", 256))
-        else:
-            global_state.mstate.stack.append(len(global_state.last_return_data))
-        return [global_state]
-
-    # -- block ----------------------------------------------------------------
-
-    @StateTransition()
-    def blockhash_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        blocknumber = state.stack.pop()
-        state.stack.append(
-            global_state.new_bitvec("blockhash_block_" + str(blocknumber), 256)
-        )
-        return [global_state]
-
-    @StateTransition()
-    def coinbase_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("coinbase", 256))
-        return [global_state]
-
-    @StateTransition()
-    def timestamp_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("timestamp", 256))
-        return [global_state]
-
-    @StateTransition()
-    def number_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.block_number)
-        return [global_state]
-
-    @StateTransition()
-    def difficulty_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("block_difficulty", 256))
-        return [global_state]
-
-    @StateTransition()
-    def basefee_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("basefee", 256))
-        return [global_state]
-
-    @StateTransition()
-    def gaslimit_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.mstate.gas_limit)
-        return [global_state]
-
-    # -- memory ---------------------------------------------------------------
-
-    @StateTransition()
-    def mload_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        offset = state.stack.pop()
-        state.mem_extend(offset, 32)
-        state.stack.append(state.memory.get_word_at(offset))
-        return [global_state]
-
-    @StateTransition()
-    def mstore_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        mstart, value = state.stack.pop(), state.stack.pop()
-        try:
-            state.mem_extend(mstart, 32)
-        except Exception:
-            log.debug("Error extending memory")
-        state.memory.write_word_at(mstart, value)
-        return [global_state]
-
-    @StateTransition()
-    def mstore8_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        offset, value = state.stack.pop(), state.stack.pop()
-        state.mem_extend(offset, 1)
-        try:
-            value_to_write: Union[int, BitVec] = util.get_concrete_int(value) % 256
-        except TypeError:
-            value_to_write = Extract(7, 0, value)
-        state.memory[offset] = value_to_write
-        return [global_state]
-
     # -- storage --------------------------------------------------------------
 
     @StateTransition()
@@ -1104,16 +330,6 @@ class Instruction:
         global_state.mstate.stack.append(program_counter)
         return [global_state]
 
-    @StateTransition()
-    def msize_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.mstate.memory_size)
-        return [global_state]
-
-    @StateTransition()
-    def gas_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("gas", 256))
-        return [global_state]
-
     @StateTransition(is_state_mutation_instruction=True)
     def log_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
@@ -1123,183 +339,188 @@ class Instruction:
         # event logs are not tracked
         return [global_state]
 
-    # -- create ---------------------------------------------------------------
-
-    def _create_transaction_helper(
-        self, global_state, call_value, mem_offset, mem_size, create2_salt=None
-    ) -> List[GlobalState]:
-        mstate = global_state.mstate
-        environment = global_state.environment
-        world_state = global_state.world_state
-
-        call_data = get_call_data(global_state, mem_offset, mem_offset + mem_size)
-
-        code_raw = []
-        code_end = call_data.size
-        size = call_data.size
-        if isinstance(size, BitVec):
-            if size.symbolic:
-                size = 10**5
-            else:
-                size = size.value
-        for i in range(size):
-            if call_data[i].symbolic:
-                code_end = i
-                break
-            code_raw.append(call_data[i].value)
-
-        if len(code_raw) < 1:
-            global_state.mstate.stack.append(1)
-            log.debug("No code found for trying to execute a create type instruction.")
-            return [global_state]
-
-        code_str = bytes(code_raw).hex()
-        next_transaction_id = get_next_transaction_id()
-        constructor_arguments = ConcreteCalldata(next_transaction_id, call_data[code_end:])
-        code = Disassembly(code_str)
-
-        caller = environment.active_account.address
-        gas_price = environment.gasprice
-        origin = environment.origin
-
-        contract_address: Union[BitVec, int, None] = None
-        Instruction._sha3_gas_helper(global_state, len(code_str) // 2)
-
-        if create2_salt is not None:
-            if create2_salt.symbolic:
-                if create2_salt.size() != 256:
-                    pad = symbol_factory.BitVecVal(0, 256 - create2_salt.size())
-                    create2_salt = Concat(pad, create2_salt)
-                address, constraint = keccak_function_manager.create_keccak(
-                    Concat(
-                        symbol_factory.BitVecVal(255, 8),
-                        Extract(159, 0, caller),
-                        create2_salt,
-                        symbol_factory.BitVecVal(int(get_code_hash(code_str), 16), 256),
-                    )
-                )
-                contract_address = Extract(159, 0, address)
-                global_state.world_state.constraints.append(constraint)
-            else:
-                salt = hex(create2_salt.value)[2:]
-                salt = "0" * (64 - len(salt)) + salt
-                addr = hex(caller.value)[2:]
-                addr = "0" * (40 - len(addr)) + addr
-                contract_address = int(
-                    get_code_hash("0xff" + addr + salt + get_code_hash(code_str)[2:])[26:],
-                    16,
-                )
-        transaction = ContractCreationTransaction(
-            world_state=world_state,
-            caller=caller,
-            code=code,
-            call_data=constructor_arguments,
-            gas_price=gas_price,
-            gas_limit=mstate.gas_limit,
-            origin=origin,
-            call_value=call_value,
-            contract_address=contract_address,
-        )
-        raise TransactionStartSignal(transaction, self.op_code, global_state)
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def create_(self, global_state: GlobalState) -> List[GlobalState]:
-        call_value, mem_offset, mem_size = global_state.mstate.pop(3)
-        return self._create_transaction_helper(global_state, call_value, mem_offset, mem_size)
+    # -- memory ---------------------------------------------------------------
 
     @StateTransition()
-    def create_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self._handle_create_type_post(global_state)
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def create2_(self, global_state: GlobalState) -> List[GlobalState]:
-        call_value, mem_offset, mem_size, salt = global_state.mstate.pop(4)
-        return self._create_transaction_helper(
-            global_state, call_value, mem_offset, mem_size, salt
-        )
-
-    @StateTransition()
-    def create2_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self._handle_create_type_post(global_state, opcode="create2")
-
-    @staticmethod
-    def _handle_create_type_post(global_state, opcode="create"):
-        if opcode == "create2":
-            global_state.mstate.pop(4)
-        else:
-            global_state.mstate.pop(3)
-        if global_state.last_return_data:
-            return_val = symbol_factory.BitVecVal(int(global_state.last_return_data, 16), 256)
-        else:
-            return_val = symbol_factory.BitVecVal(0, 256)
-        global_state.mstate.stack.append(return_val)
+    def mload_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        offset = state.stack.pop()
+        state.mem_extend(offset, 32)
+        state.stack.append(state.memory.get_word_at(offset))
         return [global_state]
 
-    # -- transaction end ------------------------------------------------------
-
     @StateTransition()
-    def return_(self, global_state: GlobalState):
+    def mstore_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        offset, length = state.stack.pop(), state.stack.pop()
-        if length.symbolic:
-            return_data = [global_state.new_bitvec("return_data", 8)]
-            log.debug("Return with symbolic length or offset. Not supported")
-        else:
-            state.mem_extend(offset, length)
-            StateTransition.check_gas_usage_limit(global_state)
-            return_data = [
-                b.value if isinstance(b, BitVec) and b.value is not None else b
-                for b in state.memory[offset : offset + length]
-            ]
-        global_state.current_transaction.end(global_state, return_data)
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def suicide_(self, global_state: GlobalState):
-        target = global_state.mstate.stack.pop()
-        transfer_amount = global_state.environment.active_account.balance()
-        global_state.world_state.balances[_as_bitvec(target)] = (
-            global_state.world_state.balances[_as_bitvec(target)] + transfer_amount
-        )
-        global_state.environment.active_account = deepcopy(
-            global_state.environment.active_account
-        )
-        global_state.accounts[
-            global_state.environment.active_account.address.value
-        ] = global_state.environment.active_account
-        global_state.environment.active_account.set_balance(0)
-        global_state.environment.active_account.deleted = True
-        global_state.current_transaction.end(global_state)
-
-    @StateTransition()
-    def revert_(self, global_state: GlobalState) -> None:
-        state = global_state.mstate
-        offset, length = state.stack.pop(), state.stack.pop()
-        return_data = [global_state.new_bitvec("return_data", 8)]
+        mstart, value = state.stack.pop(), state.stack.pop()
         try:
-            return_data = [
-                b.value if isinstance(b, BitVec) and b.value is not None else b
-                for b in state.memory[
-                    util.get_concrete_int(offset) : util.get_concrete_int(offset + length)
-                ]
-            ]
+            state.mem_extend(mstart, 32)
+        except Exception:
+            log.debug("Error extending memory")
+        state.memory.write_word_at(mstart, value)
+        return [global_state]
+
+    @StateTransition()
+    def mstore8_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        offset, value = state.stack.pop(), state.stack.pop()
+        state.mem_extend(offset, 1)
+        try:
+            value_to_write: Union[int, BitVec] = util.get_concrete_int(value) % 256
         except TypeError:
-            log.debug("Revert with symbolic length or offset. Not supported")
-        global_state.current_transaction.end(
-            global_state, return_data=return_data, revert=True
+            value_to_write = Extract(7, 0, value)
+        state.memory[offset] = value_to_write
+        return [global_state]
+
+    # -- arithmetic -----------------------------------------------------------
+
+    @StateTransition()
+    def addmod_(self, global_state: GlobalState) -> List[GlobalState]:
+        mstate = global_state.mstate
+        s0, s1, s2 = (
+            util.pop_bitvec(mstate),
+            util.pop_bitvec(mstate),
+            util.pop_bitvec(mstate),
         )
+        if s2.value == 0:
+            mstate.stack.append(symbol_factory.BitVecVal(0, 256))
+        elif s2.symbolic:
+            mstate.stack.append(
+                If(
+                    s2 == 0,
+                    symbol_factory.BitVecVal(0, 256),
+                    URem(URem(s0, s2) + URem(s1, s2), s2),
+                )
+            )
+        else:
+            # widen to 257 bits so the intermediate sum cannot wrap
+            from mythril_tpu.smt import ZeroExt
+
+            wide = URem(
+                cast(BitVec, ZeroExt(1, URem(s0, s2)) + ZeroExt(1, URem(s1, s2))),
+                ZeroExt(1, s2),
+            )
+            mstate.stack.append(Extract(255, 0, wide))
+        return [global_state]
 
     @StateTransition()
-    def assert_fail_(self, global_state: GlobalState):
-        # 0xfe: designated invalid opcode
-        raise InvalidInstruction
+    def mulmod_(self, global_state: GlobalState) -> List[GlobalState]:
+        mstate = global_state.mstate
+        s0, s1, s2 = (
+            util.pop_bitvec(mstate),
+            util.pop_bitvec(mstate),
+            util.pop_bitvec(mstate),
+        )
+        if s2.value == 0:
+            mstate.stack.append(symbol_factory.BitVecVal(0, 256))
+        elif s2.symbolic:
+            mstate.stack.append(
+                If(
+                    s2 == 0,
+                    symbol_factory.BitVecVal(0, 256),
+                    URem(URem(s0, s2) * URem(s1, s2), s2),
+                )
+            )
+        else:
+            from mythril_tpu.smt import ZeroExt
+
+            wide = URem(
+                cast(BitVec, ZeroExt(256, URem(s0, s2)) * ZeroExt(256, URem(s1, s2))),
+                ZeroExt(256, s2),
+            )
+            mstate.stack.append(Extract(255, 0, wide))
+        return [global_state]
 
     @StateTransition()
-    def invalid_(self, global_state: GlobalState):
-        raise InvalidInstruction
+    def exp_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        base, exponent = util.pop_bitvec(state), util.pop_bitvec(state)
+        if base.symbolic or exponent.symbolic:
+            state.stack.append(
+                global_state.new_bitvec(
+                    "invhash(" + str(hash(simplify(base))) + ")**invhash("
+                    + str(hash(simplify(exponent))) + ")",
+                    256,
+                    base.annotations.union(exponent.annotations),
+                )
+            )
+        else:
+            state.stack.append(
+                symbol_factory.BitVecVal(
+                    pow(base.value, exponent.value, 2**256),
+                    256,
+                    annotations=base.annotations.union(exponent.annotations),
+                )
+            )
+        return [global_state]
 
     @StateTransition()
-    def stop_(self, global_state: GlobalState):
-        global_state.current_transaction.end(global_state)
+    def signextend_(self, global_state: GlobalState) -> List[GlobalState]:
+        mstate = global_state.mstate
+        s0, s1 = mstate.stack.pop(), mstate.stack.pop()
+        try:
+            s0 = util.get_concrete_int(s0)
+            s1 = util.get_concrete_int(s1)
+        except TypeError:
+            log.debug("Unsupported symbolic argument for SIGNEXTEND")
+            mstate.stack.append(
+                global_state.new_bitvec("SIGNEXTEND({},{})".format(hash(s0), hash(s1)), 256)
+            )
+            return [global_state]
+        if s0 <= 31:
+            testbit = s0 * 8 + 7
+            if s1 & (1 << testbit):
+                mstate.stack.append(s1 | (TT256 - (1 << testbit)))
+            else:
+                mstate.stack.append(s1 & ((1 << testbit) - 1))
+        else:
+            mstate.stack.append(s1)
+        return [global_state]
+
+    # -- bitwise --------------------------------------------------------------
+
+    @StateTransition()
+    def not_(self, global_state: GlobalState):
+        mstate = global_state.mstate
+        mstate.stack.append(symbol_factory.BitVecVal(TT256M1, 256) - util.pop_bitvec(mstate))
+        return [global_state]
+
+    @StateTransition()
+    def byte_(self, global_state: GlobalState) -> List[GlobalState]:
+        mstate = global_state.mstate
+        op0, op1 = mstate.stack.pop(), mstate.stack.pop()
+        if not isinstance(op1, Expression):
+            op1 = symbol_factory.BitVecVal(op1, 256)
+        try:
+            index = util.get_concrete_int(op0)
+            offset = (31 - index) * 8
+            if offset >= 0:
+                result: Union[int, Expression] = simplify(
+                    Concat(
+                        symbol_factory.BitVecVal(0, 248),
+                        Extract(offset + 7, offset, op1),
+                    )
+                )
+            else:
+                result = 0
+        except TypeError:
+            log.debug("BYTE: Unsupported symbolic byte offset")
+            result = global_state.new_bitvec(
+                str(simplify(op1)) + "[" + str(simplify(op0)) + "]", 256
+            )
+        mstate.stack.append(result)
+        return [global_state]
+
+    # -- comparisons ----------------------------------------------------------
+
+    @StateTransition()
+    def iszero_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        val = state.stack.pop()
+        exp = Not(val) if isinstance(val, Bool) else val == 0
+        exp = If(exp, symbol_factory.BitVecVal(1, 256), symbol_factory.BitVecVal(0, 256))
+        state.stack.append(simplify(exp))
+        return [global_state]
 
     # -- call family ----------------------------------------------------------
 
@@ -1601,3 +822,718 @@ class Instruction:
         global_state.mstate.stack.append(return_value)
         global_state.world_state.constraints.append(return_value == 1)
         return [global_state]
+
+
+    # -- transaction end ------------------------------------------------------
+
+    @StateTransition()
+    def return_(self, global_state: GlobalState):
+        state = global_state.mstate
+        offset, length = state.stack.pop(), state.stack.pop()
+        if length.symbolic:
+            return_data = [global_state.new_bitvec("return_data", 8)]
+            log.debug("Return with symbolic length or offset. Not supported")
+        else:
+            state.mem_extend(offset, length)
+            StateTransition.check_gas_usage_limit(global_state)
+            return_data = [
+                b.value if isinstance(b, BitVec) and b.value is not None else b
+                for b in state.memory[offset : offset + length]
+            ]
+        global_state.current_transaction.end(global_state, return_data)
+
+    @StateTransition(is_state_mutation_instruction=True)
+    def suicide_(self, global_state: GlobalState):
+        target = global_state.mstate.stack.pop()
+        transfer_amount = global_state.environment.active_account.balance()
+        global_state.world_state.balances[_as_bitvec(target)] = (
+            global_state.world_state.balances[_as_bitvec(target)] + transfer_amount
+        )
+        global_state.environment.active_account = deepcopy(
+            global_state.environment.active_account
+        )
+        global_state.accounts[
+            global_state.environment.active_account.address.value
+        ] = global_state.environment.active_account
+        global_state.environment.active_account.set_balance(0)
+        global_state.environment.active_account.deleted = True
+        global_state.current_transaction.end(global_state)
+
+    @StateTransition()
+    def revert_(self, global_state: GlobalState) -> None:
+        state = global_state.mstate
+        offset, length = state.stack.pop(), state.stack.pop()
+        return_data = [global_state.new_bitvec("return_data", 8)]
+        try:
+            return_data = [
+                b.value if isinstance(b, BitVec) and b.value is not None else b
+                for b in state.memory[
+                    util.get_concrete_int(offset) : util.get_concrete_int(offset + length)
+                ]
+            ]
+        except TypeError:
+            log.debug("Revert with symbolic length or offset. Not supported")
+        global_state.current_transaction.end(
+            global_state, return_data=return_data, revert=True
+        )
+
+    @StateTransition()
+    def assert_fail_(self, global_state: GlobalState):
+        # 0xfe: designated invalid opcode
+        raise InvalidInstruction
+
+    @StateTransition()
+    def invalid_(self, global_state: GlobalState):
+        raise InvalidInstruction
+
+    @StateTransition()
+    def stop_(self, global_state: GlobalState):
+        global_state.current_transaction.end(global_state)
+
+    # -- create ---------------------------------------------------------------
+
+    def _create_transaction_helper(
+        self, global_state, call_value, mem_offset, mem_size, create2_salt=None
+    ) -> List[GlobalState]:
+        mstate = global_state.mstate
+        environment = global_state.environment
+        world_state = global_state.world_state
+
+        call_data = get_call_data(global_state, mem_offset, mem_offset + mem_size)
+
+        code_raw = []
+        code_end = call_data.size
+        size = call_data.size
+        if isinstance(size, BitVec):
+            if size.symbolic:
+                size = 10**5
+            else:
+                size = size.value
+        for i in range(size):
+            if call_data[i].symbolic:
+                code_end = i
+                break
+            code_raw.append(call_data[i].value)
+
+        if len(code_raw) < 1:
+            global_state.mstate.stack.append(1)
+            log.debug("No code found for trying to execute a create type instruction.")
+            return [global_state]
+
+        code_str = bytes(code_raw).hex()
+        next_transaction_id = get_next_transaction_id()
+        constructor_arguments = ConcreteCalldata(next_transaction_id, call_data[code_end:])
+        code = Disassembly(code_str)
+
+        caller = environment.active_account.address
+        gas_price = environment.gasprice
+        origin = environment.origin
+
+        contract_address: Union[BitVec, int, None] = None
+        Instruction._sha3_gas_helper(global_state, len(code_str) // 2)
+
+        if create2_salt is not None:
+            if create2_salt.symbolic:
+                if create2_salt.size() != 256:
+                    pad = symbol_factory.BitVecVal(0, 256 - create2_salt.size())
+                    create2_salt = Concat(pad, create2_salt)
+                address, constraint = keccak_function_manager.create_keccak(
+                    Concat(
+                        symbol_factory.BitVecVal(255, 8),
+                        Extract(159, 0, caller),
+                        create2_salt,
+                        symbol_factory.BitVecVal(int(get_code_hash(code_str), 16), 256),
+                    )
+                )
+                contract_address = Extract(159, 0, address)
+                global_state.world_state.constraints.append(constraint)
+            else:
+                salt = hex(create2_salt.value)[2:]
+                salt = "0" * (64 - len(salt)) + salt
+                addr = hex(caller.value)[2:]
+                addr = "0" * (40 - len(addr)) + addr
+                contract_address = int(
+                    get_code_hash("0xff" + addr + salt + get_code_hash(code_str)[2:])[26:],
+                    16,
+                )
+        transaction = ContractCreationTransaction(
+            world_state=world_state,
+            caller=caller,
+            code=code,
+            call_data=constructor_arguments,
+            gas_price=gas_price,
+            gas_limit=mstate.gas_limit,
+            origin=origin,
+            call_value=call_value,
+            contract_address=contract_address,
+        )
+        raise TransactionStartSignal(transaction, self.op_code, global_state)
+
+    @StateTransition(is_state_mutation_instruction=True)
+    def create_(self, global_state: GlobalState) -> List[GlobalState]:
+        call_value, mem_offset, mem_size = global_state.mstate.pop(3)
+        return self._create_transaction_helper(global_state, call_value, mem_offset, mem_size)
+
+    @StateTransition()
+    def create_post(self, global_state: GlobalState) -> List[GlobalState]:
+        return self._handle_create_type_post(global_state)
+
+    @StateTransition(is_state_mutation_instruction=True)
+    def create2_(self, global_state: GlobalState) -> List[GlobalState]:
+        call_value, mem_offset, mem_size, salt = global_state.mstate.pop(4)
+        return self._create_transaction_helper(
+            global_state, call_value, mem_offset, mem_size, salt
+        )
+
+    @StateTransition()
+    def create2_post(self, global_state: GlobalState) -> List[GlobalState]:
+        return self._handle_create_type_post(global_state, opcode="create2")
+
+    @staticmethod
+    def _handle_create_type_post(global_state, opcode="create"):
+        if opcode == "create2":
+            global_state.mstate.pop(4)
+        else:
+            global_state.mstate.pop(3)
+        if global_state.last_return_data:
+            return_val = symbol_factory.BitVecVal(int(global_state.last_return_data, 16), 256)
+        else:
+            return_val = symbol_factory.BitVecVal(0, 256)
+        global_state.mstate.stack.append(return_val)
+        return [global_state]
+
+    # -- call data ------------------------------------------------------------
+
+    @StateTransition()
+    def calldataload_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        op0 = state.stack.pop()
+        # concretize the offset when possible so the word read follows the
+        # natural-number (no 256-bit wrap) slice path in BaseCalldata
+        try:
+            op0 = util.get_concrete_int(op0)
+        except TypeError:
+            pass
+        try:
+            value = global_state.environment.calldata.get_word_at(op0)
+        except IndexError:
+            # pathological symbolic offset (structural walk didn't close):
+            # same pressure valve as the reference's concretize-or-bail
+            value = global_state.new_bitvec(
+                "calldata_{}[{}]".format(
+                    global_state.environment.active_account.contract_name,
+                    hash(simplify(op0)) if isinstance(op0, Expression) else op0,
+                ),
+                256,
+            )
+        state.stack.append(value)
+        return [global_state]
+
+    @StateTransition()
+    def calldatasize_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        if isinstance(global_state.current_transaction, ContractCreationTransaction):
+            log.debug("Attempt to use CALLDATASIZE in creation transaction")
+            state.stack.append(0)
+        else:
+            state.stack.append(global_state.environment.calldata.calldatasize)
+        return [global_state]
+
+    @staticmethod
+    def _calldata_copy_helper(global_state, mstate, mstart, dstart, size):
+        environment = global_state.environment
+        try:
+            mstart = util.get_concrete_int(mstart)
+        except TypeError:
+            log.debug("Unsupported symbolic memory offset in CALLDATACOPY")
+            return [global_state]
+        try:
+            dstart = util.get_concrete_int(dstart)
+        except TypeError:
+            log.debug("Unsupported symbolic calldata offset in CALLDATACOPY")
+            dstart = simplify(dstart)
+        try:
+            size = util.get_concrete_int(size)
+        except TypeError:
+            log.debug("Unsupported symbolic size in CALLDATACOPY")
+            size = 320  # excess gets overwritten
+        if size > 0:
+            try:
+                mstate.mem_extend(mstart, size)
+            except TypeError as e:
+                log.debug("Memory allocation error: %s", e)
+                mstate.mem_extend(mstart, 1)
+                mstate.memory[mstart] = global_state.new_bitvec(
+                    "calldata_" + str(environment.active_account.contract_name)
+                    + "[" + str(dstart) + ": + " + str(size) + "]",
+                    8,
+                )
+                return [global_state]
+            try:
+                i_data = dstart
+                new_memory = []
+                for i in range(size):
+                    # natural-number offsets: beyond 2^256 nothing aliases
+                    # back into calldata (no 256-bit wraparound) — reads 0
+                    if isinstance(i_data, int) and i_data >= 2 ** 256:
+                        new_memory.append(symbol_factory.BitVecVal(0, 8))
+                    else:
+                        new_memory.append(environment.calldata[i_data])
+                    i_data = (
+                        i_data + 1
+                        if isinstance(i_data, int)
+                        else simplify(cast(BitVec, i_data) + 1)
+                    )
+                for i in range(len(new_memory)):
+                    mstate.memory[i + mstart] = new_memory[i]
+            except IndexError:
+                log.debug("Exception copying calldata to memory")
+                mstate.memory[mstart] = global_state.new_bitvec(
+                    "calldata_" + str(environment.active_account.contract_name)
+                    + "[" + str(dstart) + ": + " + str(size) + "]",
+                    8,
+                )
+        return [global_state]
+
+    @StateTransition()
+    def calldatacopy_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        op0, op1, op2 = state.stack.pop(), state.stack.pop(), state.stack.pop()
+        if isinstance(global_state.current_transaction, ContractCreationTransaction):
+            log.debug("Attempt to use CALLDATACOPY in creation transaction")
+            return [global_state]
+        return self._calldata_copy_helper(global_state, state, op0, op1, op2)
+
+    # -- environment ----------------------------------------------------------
+
+    @StateTransition()
+    def balance_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        address = state.stack.pop()
+        if isinstance(address, BitVec) and address.value is not None and self.dynamic_loader:
+            try:
+                account = global_state.world_state.accounts_exist_or_load(
+                    address.value, self.dynamic_loader
+                )
+                state.stack.append(account.balance())
+                return [global_state]
+            except (ValueError, AttributeError):
+                pass
+        # balances array handles both known and symbolic addresses
+        state.stack.append(global_state.world_state.balances[_as_bitvec(address)])
+        return [global_state]
+
+    @StateTransition()
+    def codesize_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        environment = global_state.environment
+        disassembly = environment.code
+        calldata = environment.calldata
+        if isinstance(global_state.current_transaction, ContractCreationTransaction):
+            # creation code followed by constructor arguments
+            no_of_bytes = len(disassembly.bytecode) // 2
+            if isinstance(calldata, ConcreteCalldata):
+                no_of_bytes += calldata.size
+            else:
+                no_of_bytes += 0x200  # space for 16 32-byte arguments
+                global_state.world_state.constraints.append(
+                    environment.calldata.calldatasize == no_of_bytes
+                )
+        else:
+            no_of_bytes = len(disassembly.bytecode) // 2
+        state.stack.append(no_of_bytes)
+        return [global_state]
+
+    @staticmethod
+    def _sha3_gas_helper(global_state, length):
+        min_gas, max_gas = calculate_sha3_gas(length)
+        global_state.mstate.min_gas_used += min_gas
+        global_state.mstate.max_gas_used += max_gas
+        StateTransition.check_gas_usage_limit(global_state)
+        return global_state
+
+    @StateTransition(enable_gas=False)
+    def sha3_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        op0, op1 = state.stack.pop(), state.stack.pop()
+        try:
+            index, length = util.get_concrete_int(op0), util.get_concrete_int(op1)
+        except TypeError:
+            # symbolic memory offset
+            if isinstance(op0, Expression):
+                op0 = simplify(op0)
+            state.stack.append(
+                symbol_factory.BitVecSym("KECCAC_mem[{}]".format(hash(op0)), 256)
+            )
+            gas_tuple = get_opcode_gas("SHA3")
+            state.min_gas_used += gas_tuple[0]
+            state.max_gas_used += gas_tuple[1]
+            return [global_state]
+
+        Instruction._sha3_gas_helper(global_state, length)
+        state.mem_extend(index, length)
+        data_list = [
+            b if isinstance(b, BitVec) else symbol_factory.BitVecVal(b, 8)
+            for b in state.memory[index : index + length]
+        ]
+        if len(data_list) > 1:
+            data = simplify(Concat(data_list))
+        elif len(data_list) == 1:
+            data = data_list[0]
+        else:
+            result = keccak_function_manager.get_empty_keccak_hash()
+            state.stack.append(result)
+            return [global_state]
+
+        result, condition = keccak_function_manager.create_keccak(data)
+        state.stack.append(result)
+        global_state.world_state.constraints.append(condition)
+        return [global_state]
+
+    @staticmethod
+    def _code_copy_helper(code, memory_offset, code_offset, size, op, global_state) -> List[GlobalState]:
+        try:
+            concrete_memory_offset = util.get_concrete_int(memory_offset)
+        except TypeError:
+            log.debug("Unsupported symbolic memory offset in %s", op)
+            return [global_state]
+        try:
+            concrete_size = util.get_concrete_int(size)
+            global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
+        except TypeError:
+            global_state.mstate.mem_extend(concrete_memory_offset, 1)
+            global_state.mstate.memory[concrete_memory_offset] = global_state.new_bitvec(
+                "code({})".format(global_state.environment.active_account.contract_name), 8
+            )
+            return [global_state]
+        try:
+            concrete_code_offset = util.get_concrete_int(code_offset)
+        except TypeError:
+            log.debug("Unsupported symbolic code offset in %s", op)
+            global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
+            for i in range(concrete_size):
+                global_state.mstate.memory[concrete_memory_offset + i] = global_state.new_bitvec(
+                    "code({})".format(global_state.environment.active_account.contract_name), 8
+                )
+            return [global_state]
+        if code[0:2] == "0x":
+            code = code[2:]
+        for i in range(concrete_size):
+            if 2 * (concrete_code_offset + i + 1) > len(code):
+                break
+            global_state.mstate.memory[concrete_memory_offset + i] = int(
+                code[2 * (concrete_code_offset + i) : 2 * (concrete_code_offset + i + 1)], 16
+            )
+        return [global_state]
+
+    @StateTransition()
+    def codecopy_(self, global_state: GlobalState) -> List[GlobalState]:
+        memory_offset, code_offset, size = (
+            global_state.mstate.stack.pop(),
+            global_state.mstate.stack.pop(),
+            global_state.mstate.stack.pop(),
+        )
+        code = global_state.environment.code.bytecode
+        if code[0:2] == "0x":
+            code = code[2:]
+        code_size = len(code) // 2
+        if isinstance(global_state.current_transaction, ContractCreationTransaction):
+            # creation code is followed by constructor arguments (modeled as
+            # calldata); copies past the code end read from there
+            mstate = global_state.mstate
+            offset = code_offset - code_size
+            if isinstance(global_state.environment.calldata, SymbolicCalldata):
+                if code_offset >= code_size:
+                    return self._calldata_copy_helper(
+                        global_state, mstate, memory_offset, offset, size
+                    )
+            else:
+                concrete_code_offset = util.get_concrete_int(code_offset)
+                concrete_size = util.get_concrete_int(size)
+                code_copy_offset = concrete_code_offset
+                code_copy_size = (
+                    concrete_size
+                    if concrete_code_offset + concrete_size <= code_size
+                    else code_size - concrete_code_offset
+                )
+                code_copy_size = code_copy_size if code_copy_size >= 0 else 0
+                calldata_copy_offset = (
+                    concrete_code_offset - code_size
+                    if concrete_code_offset - code_size > 0
+                    else 0
+                )
+                calldata_copy_size = concrete_code_offset + concrete_size - code_size
+                calldata_copy_size = calldata_copy_size if calldata_copy_size >= 0 else 0
+                [global_state] = self._code_copy_helper(
+                    code=global_state.environment.code.bytecode,
+                    memory_offset=memory_offset,
+                    code_offset=code_copy_offset,
+                    size=code_copy_size,
+                    op="CODECOPY",
+                    global_state=global_state,
+                )
+                return self._calldata_copy_helper(
+                    global_state=global_state,
+                    mstate=mstate,
+                    mstart=memory_offset + code_copy_size,
+                    dstart=calldata_copy_offset,
+                    size=calldata_copy_size,
+                )
+        return self._code_copy_helper(
+            code=global_state.environment.code.bytecode,
+            memory_offset=memory_offset,
+            code_offset=code_offset,
+            size=size,
+            op="CODECOPY",
+            global_state=global_state,
+        )
+
+    @StateTransition()
+    def extcodesize_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        addr = state.stack.pop()
+        try:
+            addr = hex(util.get_concrete_int(addr))
+        except TypeError:
+            log.debug("unsupported symbolic address for EXTCODESIZE")
+            state.stack.append(global_state.new_bitvec("extcodesize_" + str(addr), 256))
+            return [global_state]
+        try:
+            code = global_state.world_state.accounts_exist_or_load(
+                addr, self.dynamic_loader
+            ).code.bytecode
+        except (ValueError, AttributeError) as e:
+            log.debug("error accessing contract storage due to: %s", e)
+            state.stack.append(global_state.new_bitvec("extcodesize_" + str(addr), 256))
+            return [global_state]
+        state.stack.append(len(code) // 2)
+        return [global_state]
+
+    @StateTransition()
+    def extcodecopy_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        addr, memory_offset, code_offset, size = (
+            state.stack.pop(),
+            state.stack.pop(),
+            state.stack.pop(),
+            state.stack.pop(),
+        )
+        try:
+            addr = hex(util.get_concrete_int(addr))
+        except TypeError:
+            log.debug("unsupported symbolic address for EXTCODECOPY")
+            return [global_state]
+        try:
+            code = global_state.world_state.accounts_exist_or_load(
+                addr, self.dynamic_loader
+            ).code.bytecode
+        except (ValueError, AttributeError) as e:
+            log.debug("error accessing contract storage due to: %s", e)
+            return [global_state]
+        return self._code_copy_helper(
+            code=code,
+            memory_offset=memory_offset,
+            code_offset=code_offset,
+            size=size,
+            op="EXTCODECOPY",
+            global_state=global_state,
+        )
+
+    @StateTransition()
+    def extcodehash_(self, global_state: GlobalState) -> List[GlobalState]:
+        world_state = global_state.world_state
+        stack = global_state.mstate.stack
+        address = Extract(159, 0, stack.pop())
+        if address.symbolic:
+            code_hash = symbol_factory.BitVecVal(int(get_code_hash(""), 16), 256)
+        elif address.value not in world_state.accounts:
+            code_hash = symbol_factory.BitVecVal(0, 256)
+        else:
+            addr = "0" * (40 - len(hex(address.value)[2:])) + hex(address.value)[2:]
+            code = world_state.accounts_exist_or_load(addr, self.dynamic_loader).code.bytecode
+            code_hash = symbol_factory.BitVecVal(int(get_code_hash(code), 16), 256)
+        stack.append(code_hash)
+        return [global_state]
+
+    @StateTransition()
+    def returndatacopy_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        memory_offset, return_offset, size = (
+            state.stack.pop(),
+            state.stack.pop(),
+            state.stack.pop(),
+        )
+        try:
+            concrete_memory_offset = util.get_concrete_int(memory_offset)
+            concrete_return_offset = util.get_concrete_int(return_offset)
+            concrete_size = util.get_concrete_int(size)
+        except TypeError:
+            log.debug("Unsupported symbolic argument in RETURNDATACOPY")
+            return [global_state]
+        if global_state.last_return_data is None:
+            return [global_state]
+        global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
+        for i in range(concrete_size):
+            global_state.mstate.memory[concrete_memory_offset + i] = (
+                global_state.last_return_data[concrete_return_offset + i]
+                if concrete_return_offset + i < len(global_state.last_return_data)
+                else 0
+            )
+        return [global_state]
+
+    @StateTransition()
+    def returndatasize_(self, global_state: GlobalState) -> List[GlobalState]:
+        if global_state.last_return_data is None:
+            log.debug("No last_return_data found, adding an unconstrained bitvec")
+            global_state.mstate.stack.append(global_state.new_bitvec("returndatasize", 256))
+        else:
+            global_state.mstate.stack.append(len(global_state.last_return_data))
+        return [global_state]
+
+    # -- block ----------------------------------------------------------------
+
+    @StateTransition()
+    def blockhash_(self, global_state: GlobalState) -> List[GlobalState]:
+        state = global_state.mstate
+        blocknumber = state.stack.pop()
+        state.stack.append(
+            global_state.new_bitvec("blockhash_block_" + str(blocknumber), 256)
+        )
+        return [global_state]
+
+    @StateTransition()
+    def number_(self, global_state: GlobalState) -> List[GlobalState]:
+        global_state.mstate.stack.append(global_state.environment.block_number)
+        return [global_state]
+
+# ---------------------------------------------------------------------------
+# Table-generated opcode families. The simple two-operand words all share
+# one shape — pop twice, combine, push — so the semantics live in a table
+# and the handlers are stamped onto Instruction below (evaluate() finds
+# them by the usual `<opcode>_` reflection).
+
+
+def _stamp_binary(name: str, combine) -> None:
+    @StateTransition()
+    def handler(self, global_state: GlobalState) -> List[GlobalState]:
+        mstate = global_state.mstate
+        first = util.pop_bitvec(mstate)
+        second = util.pop_bitvec(mstate)
+        mstate.stack.append(combine(first, second))
+        return [global_state]
+
+    handler.__name__ = name
+    setattr(Instruction, name, handler)
+
+
+def _stamp_div_family(name: str, combine) -> None:
+    """EVM division semantics: anything / 0 == 0 (unlike SMT-LIB)."""
+
+    @StateTransition()
+    def handler(self, global_state: GlobalState) -> List[GlobalState]:
+        mstate = global_state.mstate
+        numerator = util.pop_bitvec(mstate)
+        denominator = util.pop_bitvec(mstate)
+        zero = symbol_factory.BitVecVal(0, 256)
+        if denominator.value == 0:
+            result = zero
+        elif denominator.symbolic:
+            result = If(denominator == 0, zero, combine(numerator, denominator))
+        else:
+            result = combine(numerator, denominator)
+        mstate.stack.append(result)
+        return [global_state]
+
+    handler.__name__ = name
+    setattr(Instruction, name, handler)
+
+
+# (stack top, second) -> pushed word
+_BINARY_WORD_OPS = {
+    "add_": lambda a, b: a + b,
+    "sub_": lambda a, b: a - b,
+    "mul_": lambda a, b: a * b,
+    "and_": lambda a, b: a & b,
+    "or_": lambda a, b: a | b,
+    "xor_": lambda a, b: a ^ b,
+    # shifts pop the AMOUNT first (EIP-145)
+    "shl_": lambda shift, value: value << shift,
+    "shr_": lambda shift, value: LShR(value, shift),
+    "sar_": lambda shift, value: value >> shift,
+    # comparisons push the raw Bool (consumers coerce as needed)
+    "lt_": lambda a, b: ULT(a, b),
+    "gt_": lambda a, b: UGT(a, b),
+    "slt_": lambda a, b: a < b,
+    "sgt_": lambda a, b: a > b,
+    "eq_": lambda a, b: a == b,
+}
+
+_DIV_FAMILY_OPS = {
+    "div_": lambda num, den: UDiv(num, den),
+    "sdiv_": lambda num, den: num / den,
+    "mod_": lambda num, den: URem(num, den),
+    "smod_": lambda num, den: SRem(num, den),
+}
+
+for _name, _combine in _BINARY_WORD_OPS.items():
+    _stamp_binary(_name, _combine)
+for _name, _combine in _DIV_FAMILY_OPS.items():
+    _stamp_div_family(_name, _combine)
+
+
+def _stamp_nullary_push(name: str, produce) -> None:
+    """Opcodes that just push one environment/machine value."""
+
+    @StateTransition()
+    def handler(self, global_state: GlobalState) -> List[GlobalState]:
+        global_state.mstate.stack.append(produce(global_state))
+        return [global_state]
+
+    handler.__name__ = name
+    setattr(Instruction, name, handler)
+
+
+_NULLARY_PUSH_OPS = {
+    "callvalue_": lambda gs: gs.environment.callvalue,
+    "caller_": lambda gs: gs.environment.sender,
+    "origin_": lambda gs: gs.environment.origin,
+    "address_": lambda gs: gs.environment.address,
+    "gasprice_": lambda gs: gs.environment.gasprice,
+    "chainid_": lambda gs: gs.environment.chainid,
+    "selfbalance_": lambda gs: gs.environment.active_account.balance(),
+    "gaslimit_": lambda gs: gs.mstate.gas_limit,
+    "msize_": lambda gs: gs.mstate.memory_size,
+    # remaining gas is unknowable mid-path: fresh symbol per occurrence
+    "gas_": lambda gs: gs.new_bitvec("gas", 256),
+}
+
+for _name, _produce in _NULLARY_PUSH_OPS.items():
+    _stamp_nullary_push(_name, _produce)
+
+
+def _stamp_block_context(name: str, symbol_name: str) -> None:
+    """Block-context opcodes: symbolic by default, concrete when a
+    concolic replay pinned the block environment
+    (laser/evm/transaction/dispatch.py)."""
+
+    @StateTransition()
+    def handler(self, global_state: GlobalState) -> List[GlobalState]:
+        pinned = global_state.environment.block_context.get(name[:-1])
+        global_state.mstate.stack.append(
+            pinned
+            if pinned is not None
+            else global_state.new_bitvec(symbol_name, 256)
+        )
+        return [global_state]
+
+    handler.__name__ = name
+    setattr(Instruction, name, handler)
+
+
+for _name, _symbol in (
+    ("coinbase_", "coinbase"),
+    ("timestamp_", "timestamp"),
+    ("difficulty_", "block_difficulty"),
+    ("basefee_", "basefee"),
+):
+    _stamp_block_context(_name, _symbol)

@@ -1,12 +1,16 @@
-"""Symbolic keccak modeling (reference surface:
-mythril/laser/ethereum/keccak_function_manager.py).
+"""Symbolic keccak modeling.
 
-Hashes are modeled as uninterpreted-function pairs keccak256_<size> and an
-inverse, with VerX-style constraints: each input size gets a disjoint output
-interval, outputs are ≡ 0 mod 64 (so mapping/array slots spread), and the
-inverse axiom makes the functions injective per encountered input. Concrete
-inputs are hashed for real (batched on TPU by laser/tpu/keccak_jax.py when
-many lanes hash at once)."""
+Parity surface: mythril/laser/ethereum/keccak_function_manager.py.
+
+Hash applications are uninterpreted-function pairs (keccak256_<bits>,
+inverse) constrained VerX-style so a solver can reason about them without
+bit-level keccak: the inverse axiom gives injectivity per input; every
+input width owns a disjoint 256-bit output interval (so different-width
+hashes can never collide); and outputs are 0 mod 64 so consecutive
+mapping/array slots spread apart. Concrete inputs are hashed for real
+(batched on device by laser/tpu/keccak_tpu.py when many lanes hash at
+once) and tied into the same function symbols, so symbolic and concrete
+occurrences of one input agree."""
 
 from typing import Dict, List, Optional, Tuple
 
@@ -23,10 +27,16 @@ from mythril_tpu.smt import (
     symbol_factory,
 )
 
-TOTAL_PARTS = 10**40
-PART = (2**256 - 1) // TOTAL_PARTS
-INTERVAL_DIFFERENCE = 10**30
+# output-interval bookkeeping: the 256-bit space is cut into TOTAL_PARTS
+# stripes of width PART; each input bit-length claims one stripe
+TOTAL_PARTS = 10 ** 40
+PART = (2 ** 256 - 1) // TOTAL_PARTS
+INTERVAL_DIFFERENCE = 10 ** 30
+SLOT_ALIGNMENT = 64  # hash outputs are pinned to multiples of this
+
 hash_matcher = "fffffff"  # usual prefix for hashes in concretized output
+
+KECCAK_EMPTY = 89477152217924674838424037953991966239322087453347756267410168184682657981552
 
 
 class KeccakFunctionManager:
@@ -41,84 +51,90 @@ class KeccakFunctionManager:
     def reset(self):
         self.__init__()
 
-    @staticmethod
-    def find_concrete_keccak(data: BitVec) -> BitVec:
-        """Actually hash a concrete input."""
-        return symbol_factory.BitVecVal(
-            int.from_bytes(
-                keccak256(data.value.to_bytes(data.size() // 8, byteorder="big")), "big"
-            ),
-            256,
-        )
+    # -- function symbols ----------------------------------------------------
 
     def get_function(self, length: int) -> Tuple[Function, Function]:
-        """The (keccak, inverse) UF pair for a given input bit-length."""
-        try:
-            func, inverse = self.store_function[length]
-        except KeyError:
-            func = Function("keccak256_{}".format(length), length, 256)
-            inverse = Function("keccak256_{}-1".format(length), 256, length)
-            self.store_function[length] = (func, inverse)
+        """The (keccak, inverse) pair for an input bit-length."""
+        pair = self.store_function.get(length)
+        if pair is None:
+            pair = (
+                Function("keccak256_{}".format(length), length, 256),
+                Function("keccak256_{}-1".format(length), 256, length),
+            )
+            self.store_function[length] = pair
             self.hash_result_store[length] = []
-        return func, inverse
+        return pair
+
+    def _interval_for(self, length: int) -> Tuple[int, int]:
+        """[lower, upper) output stripe owned by this input width."""
+        index = self.interval_hook_for_size.get(length)
+        if index is None:
+            index = self._index_counter
+            self.interval_hook_for_size[length] = index
+            self._index_counter -= INTERVAL_DIFFERENCE
+        lower = index * PART
+        return lower, lower + PART
+
+    # -- hashing -------------------------------------------------------------
+
+    @staticmethod
+    def find_concrete_keccak(data: BitVec) -> BitVec:
+        """Hash a concrete input for real."""
+        digest = keccak256(data.value.to_bytes(data.size() // 8, byteorder="big"))
+        return symbol_factory.BitVecVal(int.from_bytes(digest, "big"), 256)
 
     @staticmethod
     def get_empty_keccak_hash() -> BitVec:
-        """keccak256("")"""
-        val = 89477152217924674838424037953991966239322087453347756267410168184682657981552
-        return symbol_factory.BitVecVal(val, 256)
+        return symbol_factory.BitVecVal(KECCAK_EMPTY, 256)
 
     def create_keccak(self, data: BitVec) -> Tuple[BitVec, Bool]:
-        """Returns (hash expression, side condition)."""
-        length = data.size()
-        func, inverse = self.get_function(length)
+        """(hash expression, side condition) for hashing `data`."""
+        func, inverse = self.get_function(data.size())
 
         if data.symbolic is False:
-            concrete_hash = self.find_concrete_keccak(data)
-            self.concrete_hashes[data] = concrete_hash
-            self.quick_inverse[concrete_hash] = data
-            condition = And(func(data) == concrete_hash, inverse(func(data)) == data)
-            return concrete_hash, condition
+            digest = self.find_concrete_keccak(data)
+            self.concrete_hashes[data] = digest
+            self.quick_inverse[digest] = data
+            return digest, And(
+                func(data) == digest, inverse(func(data)) == data
+            )
 
-        condition = self._create_condition(func_input=data)
-        self.hash_result_store[length].append(func(data))
-        return func(data), condition
+        self.hash_result_store[data.size()].append(func(data))
+        return func(data), self._symbolic_conditions(data)
+
+    def _symbolic_conditions(self, data: BitVec) -> Bool:
+        """Injectivity + interval + alignment, OR agreement with a concrete
+        hash already computed for some input."""
+        func, inverse = self.get_function(data.size())
+        output = func(data)
+        lower, upper = self._interval_for(data.size())
+        in_own_stripe = And(
+            inverse(output) == data,
+            ULE(symbol_factory.BitVecVal(lower, 256), output),
+            ULT(output, symbol_factory.BitVecVal(upper, 256)),
+            URem(output, symbol_factory.BitVecVal(SLOT_ALIGNMENT, 256)) == 0,
+        )
+        matches_concrete = symbol_factory.Bool(False)
+        for known_input, known_digest in self.concrete_hashes.items():
+            matches_concrete = Or(
+                matches_concrete,
+                And(output == known_digest, known_input == data),
+            )
+        return And(inverse(output) == data, Or(in_own_stripe, matches_concrete))
+
+    # -- model readback --------------------------------------------------------
 
     def get_concrete_hash_data(self, model) -> Dict[int, List[Optional[int]]]:
-        """Concrete values of all symbolic hashes under a model."""
-        concrete_hashes: Dict[int, List[Optional[int]]] = {}
-        for size in self.hash_result_store:
-            concrete_hashes[size] = []
-            for val in self.hash_result_store[size]:
-                eval_ = model.eval(val.raw, model_completion=False)
-                if eval_ is not None and eval_.value is not None:
-                    concrete_hashes[size].append(eval_.value)
-        return concrete_hashes
-
-    def _create_condition(self, func_input: BitVec) -> Bool:
-        length = func_input.size()
-        func, inv = self.get_function(length)
-        try:
-            index = self.interval_hook_for_size[length]
-        except KeyError:
-            self.interval_hook_for_size[length] = self._index_counter
-            index = self._index_counter
-            self._index_counter -= INTERVAL_DIFFERENCE
-
-        lower_bound = index * PART
-        upper_bound = lower_bound + PART
-
-        cond = And(
-            inv(func(func_input)) == func_input,
-            ULE(symbol_factory.BitVecVal(lower_bound, 256), func(func_input)),
-            ULT(func(func_input), symbol_factory.BitVecVal(upper_bound, 256)),
-            URem(func(func_input), symbol_factory.BitVecVal(64, 256)) == 0,
-        )
-        concrete_cond = symbol_factory.Bool(False)
-        for key, keccak in self.concrete_hashes.items():
-            hash_eq = And(func(func_input) == keccak, key == func_input)
-            concrete_cond = Or(concrete_cond, hash_eq)
-        return And(inv(func(func_input)) == func_input, Or(cond, concrete_cond))
+        """Concrete values of every symbolic hash under a model."""
+        out: Dict[int, List[Optional[int]]] = {}
+        for size, results in self.hash_result_store.items():
+            values = []
+            for result in results:
+                evaluated = model.eval(result.raw, model_completion=False)
+                if evaluated is not None and evaluated.value is not None:
+                    values.append(evaluated.value)
+            out[size] = values
+        return out
 
 
 keccak_function_manager = KeccakFunctionManager()

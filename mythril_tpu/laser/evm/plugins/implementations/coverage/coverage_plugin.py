@@ -20,11 +20,13 @@ class InstructionCoveragePlugin(LaserPlugin):
         self.coverage: Dict[str, Tuple[int, List[bool]]] = {}
         self.initial_coverage = 0
         self.tx_id = 0
+        self._addr_maps: Dict[str, Dict[int, int]] = {}
 
     def initialize(self, symbolic_vm):
         self.coverage = {}
         self.initial_coverage = 0
         self.tx_id = 0
+        self._addr_maps = {}
 
         @symbolic_vm.laser_hook("stop_sym_exec")
         def stop_sym_exec_hook():
@@ -47,6 +49,31 @@ class InstructionCoveragePlugin(LaserPlugin):
                 )
             if global_state.mstate.pc < len(self.coverage[code][1]):
                 self.coverage[code][1][global_state.mstate.pc] = True
+
+        @symbolic_vm.laser_hook("device_coverage")
+        def device_coverage_hook(code_hex: str, byte_offsets: List[int]):
+            """Instructions retired on device (tpu-batch backend) land in
+            the same per-bytecode bitmap the host execute_state hook
+            fills — coverage % is strategy-independent."""
+            from mythril_tpu.disassembler.asm import disassemble
+
+            addr_map = self._addr_maps.get(code_hex)
+            if addr_map is None:
+                instructions = disassemble(bytes.fromhex(code_hex))
+                addr_map = {
+                    instr["address"]: i for i, instr in enumerate(instructions)
+                }
+                self._addr_maps[code_hex] = addr_map
+                if code_hex not in self.coverage:
+                    self.coverage[code_hex] = (
+                        len(instructions),
+                        [False] * len(instructions),
+                    )
+            bitmap = self.coverage[code_hex][1]
+            for offset in byte_offsets:
+                idx = addr_map.get(offset)
+                if idx is not None and idx < len(bitmap):
+                    bitmap[idx] = True
 
         @symbolic_vm.laser_hook("start_sym_trans")
         def execute_start_sym_trans_hook():

@@ -1,12 +1,19 @@
-"""Dependency pruner (reference surface:
-mythril/laser/ethereum/plugins/implementations/dependency_pruner.py).
+"""Dependency pruner.
 
-Per basic block, tracks storage locations read on paths through it; from
-transaction 2 on, blocks whose reads cannot alias any storage written in the
-previous transaction are skipped."""
+Parity surface:
+mythril/laser/ethereum/plugins/implementations/dependency_pruner.py.
+
+The observation: from the second transaction on, re-exploring a basic
+block can only produce new behavior if some SLOAD in it may alias a slot
+written by the PREVIOUS transaction. The plugin builds a per-block access
+index (which slots paths through each block load/store, whether they
+call out), carries each path's write set across transactions on a
+world-state annotation stack, and skips repeat block entries whose reads
+provably cannot alias last round's writes.
+"""
 
 import logging
-from typing import Dict, List, Set, cast
+from typing import Dict, List, Set
 
 from mythril_tpu.analysis import solver
 from mythril_tpu.exceptions import UnsatError
@@ -24,195 +31,173 @@ from mythril_tpu.laser.evm.transaction.transaction_models import (
 log = logging.getLogger(__name__)
 
 
-def get_dependency_annotation(state: GlobalState) -> DependencyAnnotation:
-    """The state's dependency annotation; on a fresh transaction the previous
-    transaction's annotation is popped from the world-state stack."""
-    annotations = cast(
-        List[DependencyAnnotation], list(state.get_annotations(DependencyAnnotation))
-    )
-    if len(annotations) == 0:
-        try:
-            world_state_annotation = get_ws_dependency_annotation(state)
-            annotation = world_state_annotation.annotations_stack.pop()
-        except IndexError:
-            annotation = DependencyAnnotation()
-        state.annotate(annotation)
-    else:
-        annotation = annotations[0]
+def _may_equal(lhs, rhs) -> bool:
+    """Satisfiability of lhs == rhs (a cheap alias check)."""
+    try:
+        solver.get_model((lhs == rhs,))
+        return True
+    except UnsatError:
+        return False
+
+
+def path_annotation(state: GlobalState) -> DependencyAnnotation:
+    """This path's annotation; a fresh transaction inherits the previous
+    transaction's annotation from the world-state stack."""
+    for annotation in state.get_annotations(DependencyAnnotation):
+        return annotation
+    try:
+        annotation = world_annotation(state).annotations_stack.pop()
+    except IndexError:
+        annotation = DependencyAnnotation()
+    state.annotate(annotation)
     return annotation
 
 
-def get_ws_dependency_annotation(state: GlobalState) -> WSDependencyAnnotation:
-    annotations = cast(
-        List[WSDependencyAnnotation],
-        list(state.world_state.get_annotations(WSDependencyAnnotation)),
-    )
-    if len(annotations) == 0:
-        annotation = WSDependencyAnnotation()
-        state.world_state.annotate(annotation)
-    else:
-        annotation = annotations[0]
+def world_annotation(state: GlobalState) -> WSDependencyAnnotation:
+    for annotation in state.world_state.get_annotations(WSDependencyAnnotation):
+        return annotation
+    annotation = WSDependencyAnnotation()
+    state.world_state.annotate(annotation)
     return annotation
+
+
+class BlockAccessIndex:
+    """What paths through each basic block (keyed by block address) do."""
+
+    def __init__(self):
+        self.loads: Dict[int, List[object]] = {}
+        self.stores: Dict[int, List[object]] = {}
+        self.calls: Dict[int, bool] = {}
+        self.all_loaded_slots: Set = set()
+
+    @staticmethod
+    def _record(table: Dict[int, List[object]], path: List[int], slot) -> None:
+        for block in path:
+            slots = table.setdefault(block, [])
+            if slot not in slots:
+                slots.append(slot)
+
+    def record_load(self, path: List[int], slot) -> None:
+        self._record(self.loads, path, slot)
+        self.all_loaded_slots.add(slot)
+
+    def record_store(self, path: List[int], slot) -> None:
+        self._record(self.stores, path, slot)
+
+    def record_call(self, path: List[int]) -> None:
+        for block in path:
+            if block in self.stores:
+                self.calls[block] = True
 
 
 class DependencyPruner(LaserPlugin):
-    """Skips blocks with no dependency on the previous transaction's writes."""
+    """Skips repeat block entries that cannot observe last round's writes."""
 
     def __init__(self):
         self._reset()
 
     def _reset(self):
         self.iteration = 0
-        self.calls_on_path: Dict[int, bool] = {}
-        self.sloads_on_path: Dict[int, List[object]] = {}
-        self.sstores_on_path: Dict[int, List[object]] = {}
-        self.storage_accessed_global: Set = set()
+        self.index = BlockAccessIndex()
 
-    def update_sloads(self, path: List[int], target_location: object) -> None:
-        for address in path:
-            if address in self.sloads_on_path:
-                if target_location not in self.sloads_on_path[address]:
-                    self.sloads_on_path[address].append(target_location)
-            else:
-                self.sloads_on_path[address] = [target_location]
+    # -- pruning decision ----------------------------------------------------
 
-    def update_sstores(self, path: List[int], target_location: object) -> None:
-        for address in path:
-            if address in self.sstores_on_path:
-                if target_location not in self.sstores_on_path[address]:
-                    self.sstores_on_path[address].append(target_location)
-            else:
-                self.sstores_on_path[address] = [target_location]
+    def wanna_execute(self, block: int, annotation: DependencyAnnotation) -> bool:
+        if block in self.index.calls:
+            return True  # calls have unknowable effects; never prune
+        block_reads = self.index.loads.get(block)
+        if block_reads is None:
+            return False  # pure block: provably nothing to observe
 
-    def update_calls(self, path: List[int]) -> None:
-        for address in path:
-            if address in self.sstores_on_path:
-                self.calls_on_path[address] = True
-
-    def wanna_execute(self, address: int, annotation: DependencyAnnotation) -> bool:
-        """Whether the block starting at `address` may depend on the previous
-        transaction's storage writes."""
-        storage_write_cache = annotation.get_storage_write_cache(self.iteration - 1)
-
-        if address in self.calls_on_path:
-            return True
-        if address not in self.sloads_on_path:
-            return False  # "pure" path with no dependencies
-
-        if address in self.storage_accessed_global:
-            for location in self.sstores_on_path:
-                try:
-                    solver.get_model((location == address,))
+        if block in self.index.all_loaded_slots:
+            # (reference behavior) a block address doubling as an accessed
+            # slot defeats the separation; bail to execution when any
+            # stored block may alias it
+            for stored_block in self.index.stores:
+                if _may_equal(stored_block, block):
                     return True
-                except UnsatError:
-                    continue
 
-        dependencies = self.sloads_on_path[address]
-        for location in storage_write_cache:
-            for dependency in dependencies:
-                try:
-                    solver.get_model((location == dependency,))
-                    return True
-                except UnsatError:
-                    continue
-            for dependency in annotation.storage_loaded:
-                try:
-                    solver.get_model((location == dependency,))
-                    return True
-                except UnsatError:
-                    continue
+        last_writes = annotation.get_storage_write_cache(self.iteration - 1)
+        observable = list(block_reads) + list(annotation.storage_loaded)
+        for written_slot in last_writes:
+            if any(_may_equal(written_slot, read) for read in observable):
+                return True
         return False
+
+    # -- hook wiring ---------------------------------------------------------
 
     def initialize(self, symbolic_vm) -> None:
         self._reset()
+
+        def on_block_entry(state: GlobalState) -> None:
+            block = state.get_current_instruction()["address"]
+            annotation = path_annotation(state)
+            annotation.path.append(block)
+            if self.iteration < 2:
+                return
+            if block not in annotation.blocks_seen:
+                annotation.blocks_seen.add(block)
+                return
+            if not self.wanna_execute(block, annotation):
+                log.debug(
+                    "Pruning block %d: reads cannot alias tx-%d writes",
+                    block,
+                    self.iteration - 1,
+                )
+                raise PluginSkipState
+
+        def on_transaction_end(state: GlobalState) -> None:
+            annotation = path_annotation(state)
+            for slot in annotation.storage_loaded:
+                self.index.record_load(annotation.path, slot)
+            for slot in annotation.storage_written:
+                self.index.record_store(annotation.path, slot)
+            if annotation.has_call:
+                self.index.record_call(annotation.path)
 
         @symbolic_vm.laser_hook("start_sym_trans")
         def start_sym_trans_hook():
             self.iteration += 1
 
-        @symbolic_vm.post_hook("JUMP")
-        def jump_hook(state: GlobalState):
-            address = state.get_current_instruction()["address"]
-            annotation = get_dependency_annotation(state)
-            annotation.path.append(address)
-            _check_basic_block(address, annotation)
-
-        @symbolic_vm.post_hook("JUMPI")
-        def jumpi_hook(state: GlobalState):
-            address = state.get_current_instruction()["address"]
-            annotation = get_dependency_annotation(state)
-            annotation.path.append(address)
-            _check_basic_block(address, annotation)
+        for jump_op in ("JUMP", "JUMPI"):
+            symbolic_vm.post_hook(jump_op)(on_block_entry)
 
         @symbolic_vm.pre_hook("SSTORE")
         def sstore_hook(state: GlobalState):
-            annotation = get_dependency_annotation(state)
-            location = state.mstate.stack[-1]
-            self.update_sstores(annotation.path, location)
-            annotation.extend_storage_write_cache(self.iteration, location)
+            annotation = path_annotation(state)
+            slot = state.mstate.stack[-1]
+            self.index.record_store(annotation.path, slot)
+            annotation.extend_storage_write_cache(self.iteration, slot)
 
         @symbolic_vm.pre_hook("SLOAD")
         def sload_hook(state: GlobalState):
-            annotation = get_dependency_annotation(state)
-            location = state.mstate.stack[-1]
-            if location not in annotation.storage_loaded:
-                annotation.storage_loaded.append(location)
-            # backwards-annotate: execution may never reach a STOP/RETURN
-            self.update_sloads(annotation.path, location)
-            self.storage_accessed_global.add(location)
+            annotation = path_annotation(state)
+            slot = state.mstate.stack[-1]
+            if slot not in annotation.storage_loaded:
+                annotation.storage_loaded.append(slot)
+            # record against the whole path so far: execution may never
+            # reach a clean transaction end
+            self.index.record_load(annotation.path, slot)
 
-        @symbolic_vm.pre_hook("CALL")
-        def call_hook(state: GlobalState):
-            annotation = get_dependency_annotation(state)
-            self.update_calls(annotation.path)
-            annotation.has_call = True
+        for call_op in ("CALL", "STATICCALL"):
 
-        @symbolic_vm.pre_hook("STATICCALL")
-        def staticcall_hook(state: GlobalState):
-            annotation = get_dependency_annotation(state)
-            self.update_calls(annotation.path)
-            annotation.has_call = True
+            def call_hook(state: GlobalState):
+                annotation = path_annotation(state)
+                self.index.record_call(annotation.path)
+                annotation.has_call = True
 
-        @symbolic_vm.pre_hook("STOP")
-        def stop_hook(state: GlobalState):
-            _transaction_end(state)
+            symbolic_vm.pre_hook(call_op)(call_hook)
 
-        @symbolic_vm.pre_hook("RETURN")
-        def return_hook(state: GlobalState):
-            _transaction_end(state)
-
-        def _transaction_end(state: GlobalState) -> None:
-            annotation = get_dependency_annotation(state)
-            for index in annotation.storage_loaded:
-                self.update_sloads(annotation.path, index)
-            for index in annotation.storage_written:
-                self.update_sstores(annotation.path, index)
-            if annotation.has_call:
-                self.update_calls(annotation.path)
-
-        def _check_basic_block(address: int, annotation: DependencyAnnotation):
-            if self.iteration < 2:
-                return
-            if address not in annotation.blocks_seen:
-                annotation.blocks_seen.add(address)
-                return
-            if self.wanna_execute(address, annotation):
-                return
-            log.debug(
-                "Skipping state: storage slots %s not read in block at address %d",
-                annotation.get_storage_write_cache(self.iteration - 1),
-                address,
-            )
-            raise PluginSkipState
+        for end_op in ("STOP", "RETURN"):
+            symbolic_vm.pre_hook(end_op)(on_transaction_end)
 
         @symbolic_vm.laser_hook("add_world_state")
         def world_state_filter_hook(state: GlobalState):
             if isinstance(state.current_transaction, ContractCreationTransaction):
                 self.iteration = 0
                 return
-            world_state_annotation = get_ws_dependency_annotation(state)
-            annotation = get_dependency_annotation(state)
-            # keep storage_written for the next transaction; reset the rest
+            annotation = path_annotation(state)
+            # keep the write cache for the next transaction; reset the rest
             annotation.path = [0]
             annotation.storage_loaded = []
-            world_state_annotation.annotations_stack.append(annotation)
+            world_annotation(state).annotations_stack.append(annotation)

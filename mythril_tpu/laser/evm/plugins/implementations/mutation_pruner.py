@@ -1,9 +1,12 @@
-"""Mutation pruner (reference surface:
-mythril/laser/ethereum/plugins/implementations/mutation_pruner.py).
+"""Mutation pruner.
 
-A transaction that performs no state mutation and provably transfers no
-value leads to a world state equivalent to its predecessor; such "clean"
-world states are dropped to inhibit path explosion."""
+Parity surface:
+mythril/laser/ethereum/plugins/implementations/mutation_pruner.py.
+
+A message call that neither touched persistent state (no SSTORE / CALL /
+STATICCALL executed) nor could have moved value leaves the world exactly
+as it found it — exploring further transactions from that world state
+duplicates work, so the open state is dropped."""
 
 from mythril_tpu.analysis import solver
 from mythril_tpu.exceptions import UnsatError
@@ -18,41 +21,40 @@ from mythril_tpu.laser.evm.transaction.transaction_models import (
 )
 from mythril_tpu.smt import UGT, symbol_factory
 
+MUTATING_OPS = ("SSTORE", "CALL", "STATICCALL")
+
+
+def _value_transfer_possible(global_state: GlobalState) -> bool:
+    callvalue = global_state.environment.callvalue
+    if isinstance(callvalue, int):
+        callvalue = symbol_factory.BitVecVal(callvalue, 256)
+    try:
+        solver.get_model(
+            tuple(
+                global_state.world_state.constraints
+                + [UGT(callvalue, symbol_factory.BitVecVal(0, 256))]
+            )
+        )
+        return True
+    except UnsatError:
+        return False
+
 
 class MutationPruner(LaserPlugin):
-    """Drops open world states whose transaction neither mutated state nor
-    could have transferred value."""
-
     def initialize(self, symbolic_vm):
-        @symbolic_vm.pre_hook("SSTORE")
-        def sstore_mutator_hook(global_state: GlobalState):
+        def mark_mutation(global_state: GlobalState):
             global_state.annotate(MutationAnnotation())
 
-        @symbolic_vm.pre_hook("CALL")
-        def call_mutator_hook(global_state: GlobalState):
-            global_state.annotate(MutationAnnotation())
-
-        @symbolic_vm.pre_hook("STATICCALL")
-        def staticcall_mutator_hook(global_state: GlobalState):
-            global_state.annotate(MutationAnnotation())
+        for opcode in MUTATING_OPS:
+            symbolic_vm.pre_hook(opcode)(mark_mutation)
 
         @symbolic_vm.laser_hook("add_world_state")
-        def world_state_filter_hook(global_state: GlobalState):
-            if isinstance(global_state.current_transaction, ContractCreationTransaction):
+        def drop_clean_world_states(global_state: GlobalState):
+            if isinstance(
+                global_state.current_transaction, ContractCreationTransaction
+            ):
                 return
-            if isinstance(global_state.environment.callvalue, int):
-                callvalue = symbol_factory.BitVecVal(
-                    global_state.environment.callvalue, 256
-                )
-            else:
-                callvalue = global_state.environment.callvalue
-            try:
-                constraints = global_state.world_state.constraints + [
-                    UGT(callvalue, symbol_factory.BitVecVal(0, 256))
-                ]
-                solver.get_model(tuple(constraints))
-                return  # value transfer possible: the state mutates balances
-            except UnsatError:
-                pass
-            if len(list(global_state.get_annotations(MutationAnnotation))) == 0:
+            if _value_transfer_possible(global_state):
+                return  # balances changed: the state is not clean
+            if not any(global_state.get_annotations(MutationAnnotation)):
                 raise PluginSkipWorldState

@@ -1,5 +1,7 @@
-"""Annotations used by the built-in laser plugins (reference surface:
-mythril/laser/ethereum/plugins/implementations/plugin_annotations.py)."""
+"""Annotations shared by the built-in laser plugins.
+
+Parity surface:
+mythril/laser/ethereum/plugins/implementations/plugin_annotations.py."""
 
 from copy import copy
 from typing import Dict, List, Set
@@ -8,7 +10,7 @@ from mythril_tpu.laser.evm.state.annotation import StateAnnotation
 
 
 class MutationAnnotation(StateAnnotation):
-    """Annotation used by the mutation pruner to record state mutations."""
+    """The path executed a state-mutating instruction (mutation pruner)."""
 
     @property
     def persist_over_calls(self) -> bool:
@@ -16,8 +18,9 @@ class MutationAnnotation(StateAnnotation):
 
 
 class DependencyAnnotation(StateAnnotation):
-    """Tracks read/write dependencies of the current path for the dependency
-    pruner."""
+    """Read/write footprint of the current path (dependency pruner)."""
+
+    __slots__ = ("storage_loaded", "storage_written", "has_call", "path", "blocks_seen")
 
     def __init__(self):
         self.storage_loaded: List = []
@@ -27,32 +30,33 @@ class DependencyAnnotation(StateAnnotation):
         self.blocks_seen: Set[int] = set()
 
     def __copy__(self):
-        result = DependencyAnnotation()
-        result.storage_loaded = copy(self.storage_loaded)
-        result.storage_written = copy(self.storage_written)
-        result.has_call = self.has_call
-        result.path = copy(self.path)
-        result.blocks_seen = copy(self.blocks_seen)
-        return result
+        clone = DependencyAnnotation()
+        clone.storage_loaded = copy(self.storage_loaded)
+        clone.storage_written = copy(self.storage_written)
+        clone.has_call = self.has_call
+        clone.path = copy(self.path)
+        clone.blocks_seen = copy(self.blocks_seen)
+        return clone
 
     def get_storage_write_cache(self, iteration: int):
         return self.storage_written.get(iteration, [])
 
     def extend_storage_write_cache(self, iteration: int, value):
-        if iteration not in self.storage_written:
-            self.storage_written[iteration] = []
-        if value not in self.storage_written[iteration]:
-            self.storage_written[iteration].append(value)
+        cache = self.storage_written.setdefault(iteration, [])
+        if value not in cache:
+            cache.append(value)
 
 
 class WSDependencyAnnotation(StateAnnotation):
-    """Carries a stack of dependency annotations across transactions on the
-    world state."""
+    """Stack of per-transaction dependency annotations riding the world
+    state between transactions."""
+
+    __slots__ = ("annotations_stack",)
 
     def __init__(self):
         self.annotations_stack: List = []
 
     def __copy__(self):
-        result = WSDependencyAnnotation()
-        result.annotations_stack = copy(self.annotations_stack)
-        return result
+        clone = WSDependencyAnnotation()
+        clone.annotations_stack = copy(self.annotations_stack)
+        return clone

@@ -1,21 +1,26 @@
-"""Factory for the built-in laser plugins (reference surface:
-mythril/laser/ethereum/plugins/plugin_factory.py)."""
+"""Factory for the built-in laser plugins.
+
+Parity surface: mythril/laser/ethereum/plugins/plugin_factory.py. Imports
+stay inside the builders so loading the factory never pulls plugin
+dependencies."""
 
 from mythril_tpu.laser.evm.plugins.plugin import LaserPlugin
 
 
 class PluginFactory:
-    """Constructs the built-in plugins."""
-
     @staticmethod
     def build_benchmark_plugin(name: str) -> LaserPlugin:
-        from mythril_tpu.laser.evm.plugins.implementations.benchmark import BenchmarkPlugin
+        from mythril_tpu.laser.evm.plugins.implementations.benchmark import (
+            BenchmarkPlugin,
+        )
 
         return BenchmarkPlugin(name)
 
     @staticmethod
     def build_mutation_pruner_plugin() -> LaserPlugin:
-        from mythril_tpu.laser.evm.plugins.implementations.mutation_pruner import MutationPruner
+        from mythril_tpu.laser.evm.plugins.implementations.mutation_pruner import (
+            MutationPruner,
+        )
 
         return MutationPruner()
 

@@ -1,28 +1,30 @@
-"""State annotations (reference surface:
-mythril/laser/ethereum/state/annotation.py). Annotations ride along with
-states/expressions; plugins and detection modules use them as taint tags and
-scratch storage."""
+"""State / expression annotations.
+
+Parity surface: mythril/laser/ethereum/state/annotation.py — annotations
+ride along with states and expressions; plugins and detection modules use
+them as taint tags and path-scoped scratch space. Three orthogonal
+behaviors are expressed as overridable properties: whether an annotation
+survives into the world state (and thus later transactions), whether it
+crosses inter-contract call boundaries, and whether forking copies it."""
 
 
 class StateAnnotation:
-    """Base class for annotations that can be attached to a GlobalState."""
+    """Attachable to a GlobalState; copied on fork by default."""
 
     @property
     def persist_to_world_state(self) -> bool:
-        """If true, the annotation is propagated to the world state and
-        therefore to all following transactions."""
+        """Propagate to the world state and all following transactions."""
         return False
 
     @property
     def persist_over_calls(self) -> bool:
-        """If true, the annotation is propagated into the global states of
-        inter-contract calls."""
+        """Propagate into the global states of inter-contract calls."""
         return False
 
 
 class NoCopyAnnotation(StateAnnotation):
-    """Annotation that is shared (not copied) when states fork; use for
-    expensive immutable payloads."""
+    """Shared (never copied) across forks — for expensive immutable
+    payloads."""
 
     def __copy__(self):
         return self

@@ -1,85 +1,81 @@
-"""The path condition (reference surface:
-mythril/laser/ethereum/state/constraints.py): a list of Bools with a
-memoized fast feasibility check."""
+"""The path condition.
 
-from copy import copy
+Parity surface: mythril/laser/ethereum/state/constraints.py — a list of
+Bools with a memoized fast feasibility check. `is_possible` runs a
+tightly budgeted solve through the incremental core (the frontier-wide
+batched device solver seeds verdicts here via seed_feasibility, see
+laser/tpu/backend.filter_feasible)."""
+
 from typing import Iterable, List, Optional, Union
 
 from mythril_tpu.smt import Bool, Solver, simplify, symbol_factory, unsat
-from mythril_tpu.smt.solver.solver_statistics import stat_smt_query
+
+FEASIBILITY_BUDGET_MS = 100
+
+
+def _lift(constraint: Union[bool, Bool]) -> Bool:
+    return constraint if isinstance(constraint, Bool) else symbol_factory.Bool(constraint)
 
 
 class Constraints(list):
-    """A collection of constraints (the path condition). `is_possible` runs a
-    budgeted feasibility check, memoized until the next append."""
+    """The conjunction of branch conditions accumulated along one path."""
 
-    def __init__(self, constraint_list: Optional[List[Bool]] = None, is_possible: Optional[bool] = None):
-        constraint_list = constraint_list or []
-        constraint_list = self._get_smt_bool_list(constraint_list)
-        super(Constraints, self).__init__(constraint_list)
-        self._default_timeout = 100  # milliseconds
+    def __init__(
+        self,
+        constraint_list: Optional[List[Bool]] = None,
+        is_possible: Optional[bool] = None,
+    ):
+        super().__init__(_lift(c) for c in (constraint_list or []))
         self._is_possible = is_possible
+
+    # -- feasibility ---------------------------------------------------------
 
     @property
     def is_possible(self) -> bool:
-        """Whether the constraint set is (quickly decidably) satisfiable;
-        `unknown` counts as possible."""
-        if self._is_possible is not None:
-            return self._is_possible
-        solver = Solver()
-        solver.set_timeout(self._default_timeout)
-        for constraint in self[:]:
-            constraint = (
-                symbol_factory.Bool(constraint) if isinstance(constraint, bool) else constraint
-            )
-            solver.add(constraint)
-        self._is_possible = solver.check() is not unsat
+        """Quick-decidable satisfiability; `unknown` counts as possible.
+        Memoized until the next append."""
+        if self._is_possible is None:
+            solver = Solver()
+            solver.set_timeout(FEASIBILITY_BUDGET_MS)
+            solver.add(*self)
+            self._is_possible = solver.check() is not unsat
         return self._is_possible
 
     def seed_feasibility(self, value: bool) -> None:
-        """Install an externally computed feasibility verdict (the batched
-        device solver decides whole frontiers at once; see
-        laser/tpu/solver_jax.py). Only sound results may be seeded."""
+        """Install an externally computed verdict (the batched device
+        solver decides whole frontiers at once). Only sound results may
+        be seeded."""
         self._is_possible = value
 
+    # -- mutation ------------------------------------------------------------
+
     def append(self, constraint: Union[bool, Bool]) -> None:
-        constraint = (
-            constraint if isinstance(constraint, Bool) else symbol_factory.Bool(constraint)
-        )
-        super(Constraints, self).append(simplify(constraint))
+        super().append(simplify(_lift(constraint)))
         self._is_possible = None
 
     def pop(self, index: int = -1) -> None:
         raise NotImplementedError
+
+    def __iadd__(self, constraints: Iterable[Union[bool, Bool]]) -> "Constraints":
+        super().__iadd__(_lift(c) for c in constraints)
+        self._is_possible = None
+        return self
+
+    # -- non-mutating combinators ---------------------------------------------
+
+    def __add__(self, constraints: Iterable[Union[bool, Bool]]) -> "Constraints":
+        combined = super().__add__([_lift(c) for c in constraints])
+        return Constraints(combined)
 
     @property
     def as_list(self) -> List[Bool]:
         return self[:]
 
     def __copy__(self) -> "Constraints":
-        constraint_list = super(Constraints, self).copy()
-        return Constraints(constraint_list, is_possible=self._is_possible)
+        return Constraints(list(self), is_possible=self._is_possible)
 
     def __deepcopy__(self, memodict=None) -> "Constraints":
         return self.__copy__()
 
-    def __add__(self, constraints: Iterable[Union[bool, Bool]]) -> "Constraints":
-        constraints_list = self._get_smt_bool_list(constraints)
-        new_constraint_list = super(Constraints, self).__add__(constraints_list)
-        return Constraints(new_constraint_list)
-
-    def __iadd__(self, constraints: Iterable[Union[bool, Bool]]) -> "Constraints":
-        list_constraints = self._get_smt_bool_list(constraints)
-        super(Constraints, self).__iadd__(list_constraints)
-        self._is_possible = None
-        return self
-
-    @staticmethod
-    def _get_smt_bool_list(constraints: Iterable[Union[bool, Bool]]) -> List[Bool]:
-        return [
-            constraint if isinstance(constraint, Bool) else symbol_factory.Bool(constraint)
-            for constraint in constraints
-        ]
-
     def __hash__(self):
-        return tuple(self[:]).__hash__()
+        return hash(tuple(self))

@@ -1,10 +1,15 @@
-"""The EVM world state (reference surface:
-mythril/laser/ethereum/state/world_state.py): accounts, the shared balances
-array, the path condition, and the recorded transaction sequence."""
+"""The EVM world state (yellow paper sigma).
+
+Parity surface: mythril/laser/ethereum/state/world_state.py — the account
+map, ONE shared symbolic balances array (plus its starting snapshot, which
+detection modules compare against), the path condition, and the recorded
+transaction sequence. Contract addresses derive from keccak(rlp([sender,
+nonce])) via the in-repo RLP encoder below (replacing
+ethereum.utils.mk_contract_address)."""
 
 from copy import copy
 from random import randint
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List
 
 from mythril_tpu.laser.evm.state.account import Account
 from mythril_tpu.laser.evm.state.annotation import StateAnnotation
@@ -12,15 +17,23 @@ from mythril_tpu.laser.evm.state.constraints import Constraints
 from mythril_tpu.support.keccak import keccak256
 from mythril_tpu.smt import Array, BitVec, symbol_factory
 
+# ------------------------------------------------------------------- RLP
+
+
+def _rlp_length_prefix(length: int, offset: int) -> bytes:
+    if length < 56:
+        return bytes([offset + length])
+    length_bytes = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(length_bytes)]) + length_bytes
+
 
 def _rlp_encode(item) -> bytes:
-    """Minimal RLP encoder (bytes / int / list) for contract-address
-    derivation: address = keccak(rlp([sender, nonce]))[12:]."""
+    """Minimal RLP (bytes / int / list) — just enough for address
+    derivation."""
     if isinstance(item, int):
-        if item == 0:
-            payload = b""
-        else:
-            payload = item.to_bytes((item.bit_length() + 7) // 8, "big")
+        payload = b"" if item == 0 else item.to_bytes(
+            (item.bit_length() + 7) // 8, "big"
+        )
         return _rlp_encode(payload)
     if isinstance(item, (bytes, bytearray)):
         if len(item) == 1 and item[0] < 0x80:
@@ -32,21 +45,15 @@ def _rlp_encode(item) -> bytes:
     raise TypeError("cannot rlp-encode %r" % type(item))
 
 
-def _rlp_length_prefix(length: int, offset: int) -> bytes:
-    if length < 56:
-        return bytes([offset + length])
-    length_bytes = length.to_bytes((length.bit_length() + 7) // 8, "big")
-    return bytes([offset + 55 + len(length_bytes)]) + length_bytes
-
-
 def mk_contract_address(sender: bytes, nonce: int) -> bytes:
-    """CREATE address derivation (replaces ethereum.utils.mk_contract_address)."""
+    """CREATE address: keccak(rlp([sender, nonce]))[12:]."""
     return keccak256(_rlp_encode([sender, nonce]))[12:]
 
 
-class WorldState:
-    """The world state as described in the yellow paper."""
+# ----------------------------------------------------------- world state
 
+
+class WorldState:
     def __init__(
         self,
         transaction_sequence=None,
@@ -61,63 +68,53 @@ class WorldState:
         self.transaction_sequence = transaction_sequence or []
         self._annotations = annotations or []
 
+    # -- account access ------------------------------------------------------
+
     @property
     def accounts(self):
         return self._accounts
 
     def __getitem__(self, item: BitVec) -> Account:
-        """Accounts are auto-created on first access."""
-        try:
-            return self._accounts[item.value]
-        except KeyError:
-            new_account = Account(address=item, code=None, balances=self.balances)
-            self._accounts[item.value] = new_account
-            return new_account
+        """Accounts auto-create on first touch (symbolic world)."""
+        account = self._accounts.get(item.value)
+        if account is None:
+            account = Account(address=item, code=None, balances=self.balances)
+            self._accounts[item.value] = account
+        return account
 
-    def __copy__(self) -> "WorldState":
-        new_annotations = [copy(a) for a in self._annotations]
-        new_world_state = WorldState(
-            transaction_sequence=self.transaction_sequence[:],
-            annotations=new_annotations,
-        )
-        new_world_state.balances = copy(self.balances)
-        new_world_state.starting_balances = copy(self.starting_balances)
-        for account in self._accounts.values():
-            new_world_state.put_account(copy(account))
-        new_world_state.node = self.node
-        new_world_state.constraints = copy(self.constraints)
-        return new_world_state
+    def put_account(self, account: Account) -> None:
+        self._accounts[account.address.value] = account
+        account._balances = self.balances
+        account.balance = lambda: account._balances[account.address]
 
     def accounts_exist_or_load(self, addr, dynamic_loader) -> Account:
-        """Existing account, or one loaded through the dynamic loader."""
-        if isinstance(addr, int):
-            addr_bitvec = symbol_factory.BitVecVal(addr, 256)
-        elif isinstance(addr, BitVec):
-            addr_bitvec = addr
+        """Existing account, or one populated through the dynamic loader."""
+        if isinstance(addr, BitVec):
+            address = addr
+        elif isinstance(addr, int):
+            address = symbol_factory.BitVecVal(addr, 256)
         else:
-            addr_bitvec = symbol_factory.BitVecVal(int(addr, 16), 256)
+            address = symbol_factory.BitVecVal(int(addr, 16), 256)
 
-        if addr_bitvec.value in self.accounts:
-            return self.accounts[addr_bitvec.value]
+        known = self._accounts.get(address.value)
+        if known is not None:
+            return known
         if dynamic_loader is None:
             raise ValueError("dynamic_loader is None")
+
         addr_hex = (
-            addr if isinstance(addr, str) else "{0:#0{1}x}".format(addr_bitvec.value, 42)
+            addr if isinstance(addr, str) else "{0:#0{1}x}".format(address.value, 42)
         )
+        code = dynamic_loader.dynld(addr_hex)
         try:
             balance = dynamic_loader.read_balance(addr_hex)
-            return self.create_account(
-                balance=balance,
-                address=addr_bitvec.value,
-                dynamic_loader=dynamic_loader,
-                code=dynamic_loader.dynld(addr_hex),
-            )
         except Exception:
-            pass
+            balance = 0
         return self.create_account(
-            address=addr_bitvec.value,
+            balance=balance,
+            address=address.value,
             dynamic_loader=dynamic_loader,
-            code=dynamic_loader.dynld(addr_hex),
+            code=code,
         )
 
     def create_account(
@@ -130,35 +127,59 @@ class WorldState:
         code=None,
         nonce=0,
     ) -> Account:
-        address = (
-            symbol_factory.BitVecVal(address, 256)
-            if address is not None
-            else self._generate_new_address(creator)
-        )
-        new_account = Account(
-            address=address,
+        if address is not None:
+            address_word = symbol_factory.BitVecVal(address, 256)
+        else:
+            address_word = self._generate_new_address(creator)
+        account = Account(
+            address=address_word,
             balances=self.balances,
             dynamic_loader=dynamic_loader,
             concrete_storage=concrete_storage,
         )
         if code:
-            new_account.code = code
-        new_account.nonce = nonce
-        new_account.set_balance(
+            account.code = code
+        account.nonce = nonce
+        account.set_balance(
             balance
             if isinstance(balance, BitVec)
             else symbol_factory.BitVecVal(balance, 256)
         )
-        self.put_account(new_account)
-        return new_account
+        self.put_account(account)
+        return account
 
     def create_initialized_contract_account(self, contract_code, storage) -> None:
-        """New contract account from runtime bytecode + initial storage."""
-        new_account = Account(
+        """Contract account from runtime bytecode + pre-filled storage."""
+        account = Account(
             self._generate_new_address(), code=contract_code, balances=self.balances
         )
-        new_account.storage = storage
-        self.put_account(new_account)
+        account.storage = storage
+        self.put_account(account)
+
+    def _generate_new_address(self, creator=None) -> BitVec:
+        if creator:
+            creator_hex = creator[2:] if creator.startswith("0x") else creator
+            derived = mk_contract_address(bytes.fromhex(creator_hex.zfill(40)), 0)
+            return symbol_factory.BitVecVal(int.from_bytes(derived, "big"), 256)
+        while True:
+            candidate = randint(0, 2 ** 160 - 1)
+            if candidate not in self._accounts:
+                return symbol_factory.BitVecVal(candidate, 256)
+
+    # -- forking / annotations ------------------------------------------------
+
+    def __copy__(self) -> "WorldState":
+        clone = WorldState(
+            transaction_sequence=self.transaction_sequence[:],
+            annotations=[copy(a) for a in self._annotations],
+        )
+        clone.balances = copy(self.balances)
+        clone.starting_balances = copy(self.starting_balances)
+        for account in self._accounts.values():
+            clone.put_account(copy(account))
+        clone.node = self.node
+        clone.constraints = copy(self.constraints)
+        return clone
 
     def annotate(self, annotation: StateAnnotation) -> None:
         self._annotations.append(annotation)
@@ -168,20 +189,4 @@ class WorldState:
         return self._annotations
 
     def get_annotations(self, annotation_type: type) -> Iterator[StateAnnotation]:
-        return filter(lambda x: isinstance(x, annotation_type), self.annotations)
-
-    def _generate_new_address(self, creator=None) -> BitVec:
-        if creator:
-            creator_hex = creator[2:] if creator.startswith("0x") else creator
-            creator_bytes = bytes.fromhex(creator_hex.zfill(40))
-            address = "0x" + mk_contract_address(creator_bytes, 0).hex()
-            return symbol_factory.BitVecVal(int(address, 16), 256)
-        while True:
-            address = "0x" + "".join([str(hex(randint(0, 16)))[-1] for _ in range(40)])
-            if address not in self._accounts.keys():
-                return symbol_factory.BitVecVal(int(address, 16), 256)
-
-    def put_account(self, account: Account) -> None:
-        self._accounts[account.address.value] = account
-        account._balances = self.balances
-        account.balance = lambda: account._balances[account.address]
+        return (a for a in self._annotations if isinstance(a, annotation_type))

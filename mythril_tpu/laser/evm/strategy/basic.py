@@ -1,55 +1,40 @@
-"""Basic search strategies (reference surface:
-mythril/laser/ethereum/strategy/basic.py)."""
+"""Work-list selection policies.
 
-from random import randrange
+Parity surface: mythril/laser/ethereum/strategy/basic.py — DFS/BFS pop
+opposite ends of the shared work list; the two random strategies draw
+uniformly / weighted by 1/(depth+1)."""
+
+import random
 from typing import List
 
 from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.strategy import BasicSearchStrategy
 
-try:
-    from random import choices
-except ImportError:
-    from itertools import accumulate
-    from random import random
-    from bisect import bisect
-
-    def choices(population, weights=None):
-        """Library-independent weighted choice."""
-        if weights is None:
-            return [population[int(random() * len(population))]]
-        cum_weights = list(accumulate(weights))
-        return [
-            population[
-                bisect(cum_weights, random() * cum_weights[-1], 0, len(population) - 1)
-            ]
-        ]
-
 
 class DepthFirstSearchStrategy(BasicSearchStrategy):
-    """LIFO work list."""
+    """LIFO: dive down one path before exploring siblings."""
 
     def get_strategic_global_state(self) -> GlobalState:
         return self.work_list.pop()
 
 
 class BreadthFirstSearchStrategy(BasicSearchStrategy):
-    """FIFO work list."""
+    """FIFO: advance the whole frontier in lockstep."""
 
     def get_strategic_global_state(self) -> GlobalState:
         return self.work_list.pop(0)
 
 
 class ReturnRandomNaivelyStrategy(BasicSearchStrategy):
-    """Uniform random selection."""
+    """Uniform random draw from the work list."""
 
     def get_strategic_global_state(self) -> GlobalState:
-        if len(self.work_list) > 0:
-            return self.work_list.pop(randrange(len(self.work_list)))
-        raise IndexError
+        if not self.work_list:
+            raise IndexError
+        return self.work_list.pop(random.randrange(len(self.work_list)))
 
     def get_strategic_batch(self, batch_size: int) -> List[GlobalState]:
-        batch = []
+        batch: List[GlobalState] = []
         while len(batch) < batch_size and self.work_list:
             try:
                 batch.append(next(self))
@@ -59,12 +44,9 @@ class ReturnRandomNaivelyStrategy(BasicSearchStrategy):
 
 
 class ReturnWeightedRandomStrategy(BasicSearchStrategy):
-    """Random selection weighted by 1 / (depth + 1)."""
+    """Random draw favoring shallow states (weight 1/(depth+1))."""
 
     def get_strategic_global_state(self) -> GlobalState:
-        probability_distribution = [
-            1 / (global_state.mstate.depth + 1) for global_state in self.work_list
-        ]
-        return self.work_list.pop(
-            choices(range(len(self.work_list)), probability_distribution)[0]
-        )
+        weights = [1 / (state.mstate.depth + 1) for state in self.work_list]
+        chosen = random.choices(range(len(self.work_list)), weights)[0]
+        return self.work_list.pop(chosen)

@@ -1,26 +1,32 @@
-"""Global execution-time budget (reference surface:
-mythril/laser/ethereum/time_handler.py). The solver couples its per-query
-timeout to the remaining execution time via time_remaining()."""
+"""Global execution-time budget.
+
+Parity surface: mythril/laser/ethereum/time_handler.py — the analysis
+solver couples its per-query budget to the time left in the run via
+time_remaining()."""
 
 import time
 
 from mythril_tpu.support.support_utils import Singleton
 
+_UNLIMITED_MS = 100_000_000
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
 
 class TimeHandler(object, metaclass=Singleton):
     def __init__(self):
-        self._start_time = None
-        self._execution_time = None
+        self._deadline_ms = None
 
     def start_execution(self, execution_time: int):
-        self._start_time = int(time.time() * 1000)
-        self._execution_time = execution_time * 1000
+        self._deadline_ms = _now_ms() + execution_time * 1000
 
     def time_remaining(self) -> int:
         """Milliseconds left in the execution budget."""
-        if self._start_time is None:
-            return 100000000
-        return self._execution_time - (int(time.time() * 1000) - self._start_time)
+        if self._deadline_ms is None:
+            return _UNLIMITED_MS
+        return self._deadline_ms - _now_ms()
 
 
 time_handler = TimeHandler()

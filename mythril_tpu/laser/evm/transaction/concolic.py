@@ -1,14 +1,15 @@
-"""Concolic message calls: everything concrete (reference surface:
-mythril/laser/ethereum/transaction/concolic.py). Used to replay
-conformance-test transactions against the interpreter with no solver in
-the loop."""
+"""Concolic message calls: every transaction field concrete.
+
+Parity surface: mythril/laser/ethereum/transaction/concolic.py — replays
+conformance-test transactions (VMTests) through the interpreter with no
+solver in the loop."""
 
 from typing import List, Union
 
 from mythril_tpu.disassembler.disassembly import Disassembly
-from mythril_tpu.laser.evm.cfg import Edge, JumpType, Node
 from mythril_tpu.laser.evm.state.calldata import ConcreteCalldata
 from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.laser.evm.transaction.dispatch import enqueue_transaction
 from mythril_tpu.laser.evm.transaction.transaction_models import (
     MessageCallTransaction,
     get_next_transaction_id,
@@ -26,52 +27,26 @@ def execute_message_call(
     gas_price,
     value,
     track_gas=False,
+    block_env=None,
 ) -> Union[None, List[GlobalState]]:
-    """Execute a concrete message call from all open states."""
+    """Run one concrete message call against every open state."""
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
 
-    for open_world_state in open_states:
-        next_transaction_id = get_next_transaction_id()
+    for world_state in open_states:
+        tx_id = get_next_transaction_id()
         transaction = MessageCallTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
+            world_state=world_state,
+            identifier=tx_id,
             gas_price=gas_price,
             gas_limit=gas_limit,
             origin=origin_address,
             code=Disassembly(code),
             caller=caller_address,
-            callee_account=open_world_state[callee_address],
-            call_data=ConcreteCalldata(next_transaction_id, data),
+            callee_account=world_state[callee_address],
+            call_data=ConcreteCalldata(tx_id, data),
             call_value=value,
         )
-        _setup_global_state_for_execution(laser_evm, transaction)
+        enqueue_transaction(laser_evm, transaction, block_env=block_env)
 
     return laser_evm.exec(track_gas=track_gas)
-
-
-def _setup_global_state_for_execution(laser_evm, transaction) -> None:
-    global_state = transaction.initial_global_state()
-    global_state.transaction_stack.append((transaction, None))
-
-    new_node = Node(
-        global_state.environment.active_account.contract_name,
-        function_name=global_state.environment.active_function_name,
-    )
-    if laser_evm.requires_statespace:
-        laser_evm.nodes[new_node.uid] = new_node
-    if transaction.world_state.node and laser_evm.requires_statespace:
-        laser_evm.edges.append(
-            Edge(
-                transaction.world_state.node.uid,
-                new_node.uid,
-                edge_type=JumpType.Transaction,
-                condition=None,
-            )
-        )
-        new_node.constraints = global_state.world_state.constraints
-
-    global_state.world_state.transaction_sequence.append(transaction)
-    global_state.node = new_node
-    new_node.states.append(global_state)
-    laser_evm.work_list.append(global_state)

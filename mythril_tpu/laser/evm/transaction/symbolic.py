@@ -1,18 +1,19 @@
-"""Symbolic transaction setup (reference surface:
-mythril/laser/ethereum/transaction/symbolic.py): fully-symbolic message
-calls / creation transactions from every open world state, with the caller
-constrained to the ACTORS set."""
+"""Fully-symbolic transaction setup.
+
+Parity surface: mythril/laser/ethereum/transaction/symbolic.py — one
+unconstrained message call per open world state (symbolic calldata,
+value, gas price; the sender constrained into the ACTORS set), and the
+creation transaction that starts an analysis."""
 
 import logging
 from typing import Optional
 
 from mythril_tpu.disassembler.disassembly import Disassembly
-from mythril_tpu.laser.evm.cfg import Edge, JumpType, Node
 from mythril_tpu.laser.evm.state.account import Account
 from mythril_tpu.laser.evm.state.calldata import SymbolicCalldata
 from mythril_tpu.laser.evm.state.world_state import WorldState
+from mythril_tpu.laser.evm.transaction.dispatch import enqueue_transaction
 from mythril_tpu.laser.evm.transaction.transaction_models import (
-    BaseTransaction,
     ContractCreationTransaction,
     MessageCallTransaction,
     get_next_transaction_id,
@@ -21,9 +22,11 @@ from mythril_tpu.smt import BitVec, Or, symbol_factory
 
 log = logging.getLogger(__name__)
 
+BLOCK_GAS_LIMIT = 8_000_000
+
 
 class Actors:
-    """The fixed addresses used as transaction senders during analysis."""
+    """The fixed sender addresses the analysis reasons about."""
 
     def __init__(
         self,
@@ -42,10 +45,10 @@ class Actors:
             if actor in ("CREATOR", "ATTACKER"):
                 raise ValueError("Can't delete creator or attacker address")
             del self.addresses[actor]
-        else:
-            if address[0:2] != "0x":
-                raise ValueError("Actor address not in valid format")
-            self.addresses[actor] = symbol_factory.BitVecVal(int(address[2:], 16), 256)
+            return
+        if not address.startswith("0x"):
+            raise ValueError("Actor address not in valid format")
+        self.addresses[actor] = symbol_factory.BitVecVal(int(address[2:], 16), 256)
 
     def __getitem__(self, actor: str):
         return self.addresses[actor]
@@ -65,36 +68,44 @@ class Actors:
 ACTORS = Actors()
 
 
+def _fresh_symbol(prefix: str, tx_id) -> BitVec:
+    return symbol_factory.BitVecSym("{}{}".format(prefix, tx_id), 256)
+
+
 def execute_message_call(laser_evm, callee_address: BitVec) -> None:
-    """Start a fully-symbolic message call from every open world state."""
+    """One fully-symbolic message call per open world state."""
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
 
-    for open_world_state in open_states:
-        if open_world_state[callee_address].deleted:
+    for world_state in open_states:
+        if world_state[callee_address].deleted:
             log.debug("Can not execute dead contract, skipping.")
             continue
-
-        next_transaction_id = get_next_transaction_id()
-        external_sender = symbol_factory.BitVecSym(
-            "sender_{}".format(next_transaction_id), 256
-        )
+        tx_id = get_next_transaction_id()
+        sender = _fresh_symbol("sender_", tx_id)
         transaction = MessageCallTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
-            gas_price=symbol_factory.BitVecSym(
-                "gas_price{}".format(next_transaction_id), 256
-            ),
-            gas_limit=8000000,  # block gas limit
-            origin=external_sender,
-            caller=external_sender,
-            callee_account=open_world_state[callee_address],
-            call_data=SymbolicCalldata(next_transaction_id),
-            call_value=symbol_factory.BitVecSym(
-                "call_value{}".format(next_transaction_id), 256
-            ),
+            world_state=world_state,
+            identifier=tx_id,
+            gas_price=_fresh_symbol("gas_price", tx_id),
+            gas_limit=BLOCK_GAS_LIMIT,
+            origin=sender,
+            caller=sender,
+            callee_account=world_state[callee_address],
+            call_data=SymbolicCalldata(tx_id),
+            call_value=_fresh_symbol("call_value", tx_id),
         )
-        _setup_global_state_for_execution(laser_evm, transaction)
+        enqueue_transaction(
+            laser_evm,
+            transaction,
+            extra_constraints=[
+                Or(
+                    *[
+                        transaction.caller == actor
+                        for actor in ACTORS.addresses.values()
+                    ]
+                )
+            ],
+        )
 
     laser_evm.exec()
 
@@ -102,65 +113,29 @@ def execute_message_call(laser_evm, callee_address: BitVec) -> None:
 def execute_contract_creation(
     laser_evm, contract_initialization_code, contract_name=None, world_state=None
 ) -> Account:
-    """Execute a creation transaction built from initialization code."""
+    """The creation transaction an analysis starts from."""
     del laser_evm.open_states[:]
     world_state = world_state or WorldState()
-    open_states = [world_state]
-    new_account = None
-    for open_world_state in open_states:
-        next_transaction_id = get_next_transaction_id()
-        transaction = ContractCreationTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
-            gas_price=symbol_factory.BitVecSym(
-                "gas_price{}".format(next_transaction_id), 256
-            ),
-            gas_limit=8000000,
-            origin=ACTORS["CREATOR"],
-            code=Disassembly(contract_initialization_code),
-            caller=ACTORS["CREATOR"],
-            contract_name=contract_name,
-            call_data=None,
-            call_value=symbol_factory.BitVecSym(
-                "call_value{}".format(next_transaction_id), 256
-            ),
-        )
-        _setup_global_state_for_execution(laser_evm, transaction)
-        new_account = new_account or transaction.callee_account
 
+    tx_id = get_next_transaction_id()
+    transaction = ContractCreationTransaction(
+        world_state=world_state,
+        identifier=tx_id,
+        gas_price=_fresh_symbol("gas_price", tx_id),
+        gas_limit=BLOCK_GAS_LIMIT,
+        origin=ACTORS["CREATOR"],
+        code=Disassembly(contract_initialization_code),
+        caller=ACTORS["CREATOR"],
+        contract_name=contract_name,
+        call_data=None,
+        call_value=_fresh_symbol("call_value", tx_id),
+    )
+    enqueue_transaction(
+        laser_evm,
+        transaction,
+        extra_constraints=[
+            Or(*[transaction.caller == actor for actor in ACTORS.addresses.values()])
+        ],
+    )
     laser_evm.exec(True)
-    return new_account
-
-
-def _setup_global_state_for_execution(laser_evm, transaction: BaseTransaction) -> None:
-    """Set up the initial global state and CFG node for a transaction."""
-    global_state = transaction.initial_global_state()
-    global_state.transaction_stack.append((transaction, None))
-
-    global_state.world_state.constraints.append(
-        Or(*[transaction.caller == actor for actor in ACTORS.addresses.values()])
-    )
-
-    new_node = Node(
-        global_state.environment.active_account.contract_name,
-        function_name=global_state.environment.active_function_name,
-    )
-    if laser_evm.requires_statespace:
-        laser_evm.nodes[new_node.uid] = new_node
-
-    if transaction.world_state.node:
-        if laser_evm.requires_statespace:
-            laser_evm.edges.append(
-                Edge(
-                    transaction.world_state.node.uid,
-                    new_node.uid,
-                    edge_type=JumpType.Transaction,
-                    condition=None,
-                )
-            )
-        new_node.constraints = global_state.world_state.constraints
-
-    global_state.world_state.transaction_sequence.append(transaction)
-    global_state.node = new_node
-    new_node.states.append(global_state)
-    laser_evm.work_list.append(global_state)
+    return transaction.callee_account

@@ -98,6 +98,10 @@ class TpuBatchStrategy(BasicSearchStrategy):
         self.batch_cfg = batch_cfg or DEFAULT_BATCH_CFG
         self.device_rounds = 0
         self.device_steps_retired = 0
+        # compile the device kernels NOW, before sym_exec starts the
+        # execution clock: a cold XLA compile inside the timed loop would
+        # be billed against --execution-timeout and can truncate analyses
+        warmup_device(self.batch_cfg)
 
     def get_strategic_global_state(self) -> GlobalState:
         return self.work_list.pop(0)
@@ -139,6 +143,100 @@ def host_op_bytes(laser) -> set:
 # a device dispatch; above it, one batched call decides every path condition
 MIN_DEVICE_SOLVE_BATCH = 4
 
+# device-phase step budget per exec_batch round
+DEVICE_STEP_BUDGET = 4096
+
+_warmed_cfgs = set()
+
+
+def warmup_device(cfg: BatchConfig) -> None:
+    """Compile the step kernel (and the batched-solver kernel) for this
+    batch config on an empty batch — every lane dead, so execution is a
+    no-op but XLA compiles (and the persistent compile cache fills)."""
+    if cfg in _warmed_cfgs:
+        return
+    _warmed_cfgs.add(cfg)
+    try:
+        import jax.numpy as jnp
+
+        from mythril_tpu.laser.tpu.batch import (
+            StateBatch,
+            batch_shapes,
+            make_code_bank,
+        )
+
+        np_batch = {
+            field: np.zeros(shape, dtype)
+            for field, (shape, dtype) in batch_shapes(cfg).items()
+        }
+        st = StateBatch(**{f: jnp.asarray(v) for f, v in np_batch.items()})
+        cb = make_code_bank([b"\x00"], cfg.code_len, host_ops=(), freeze_errors=True)
+        _run_device(cb, st, cfg)
+        from mythril_tpu.smt import terms as _terms
+
+        warm_formula = [_terms.bool_eq(_terms.bv_var("!warmup", 8), _terms.bv_const(1, 8))]
+        solver_jax.check_batch([warm_formula] * MIN_DEVICE_SOLVE_BATCH)
+    except Exception as e:  # pragma: no cover - warmup is best-effort
+        log.warning("device warmup failed (continuing cold): %s", e)
+
+
+# lockstep steps between rebalance opportunities on a multi-device mesh
+MESH_STEPS_PER_ROUND = 256
+
+
+# mesh execution policy: "auto" shards over every visible accelerator
+# device but stays single-device on the CPU backend (the virtual-8-CPU
+# test mesh makes EVERY analysis pay SPMD partitioning cost otherwise);
+# "on" forces sharding (the dedicated virtual-mesh integration test),
+# "off" forces the single-device path.
+MESH_MODE = "auto"
+
+
+def _use_mesh(n_devices: int, platform: str) -> bool:
+    if MESH_MODE == "on":
+        return n_devices > 1
+    if MESH_MODE == "off":
+        return False
+    return n_devices > 1 and platform != "cpu"
+
+
+def _run_device(cb, st, cfg):
+    """Run the packed batch to quiescence: single-device fast path, or —
+    with more than one visible device — lane-sharded SPMD over a mesh with
+    occupancy-gated all-to-all rebalancing (SURVEY §5 distributed backend;
+    the production wiring of mesh.round_impl that the dryrun exercises)."""
+    import jax
+
+    from mythril_tpu.laser.tpu import mesh as mesh_lib
+    from mythril_tpu.laser.tpu.batch import RUNNING as _RUNNING
+
+    devices = jax.devices()
+    n_shards = len(devices)
+    if (
+        not _use_mesh(n_shards, devices[0].platform)
+        or cfg.lanes % n_shards != 0
+    ):
+        return run(cb, default_env(), st, max_steps=DEVICE_STEP_BUDGET)
+
+    mesh = mesh_lib.make_mesh()
+    st = mesh_lib.shard_batch(st, mesh)
+    cb, env = mesh_lib.put_replicated((cb, default_env()), mesh)
+    steps_done = 0
+    while steps_done < DEVICE_STEP_BUDGET:
+        do_reb = mesh_lib.should_rebalance(st, n_shards)
+        st = mesh_lib.sharded_round(
+            cb,
+            env,
+            st,
+            steps_per_round=MESH_STEPS_PER_ROUND,
+            do_rebalance=do_reb,
+            n_shards=n_shards,
+        )
+        steps_done += MESH_STEPS_PER_ROUND
+        if not bool(np.asarray(st.alive & (st.status == _RUNNING)).any()):
+            break
+    return st
+
 
 def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
     """Frontier-wide feasibility: decide every undecided path condition in
@@ -169,12 +267,75 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
     return [s for s in states if s.world_state.constraints.is_possible]
 
 
-def exec_batch(laser, track_gas=False) -> None:
-    """Drain the work list through alternating host/device phases."""
+def _apply_loop_bound(laser, states: List[GlobalState]) -> List[GlobalState]:
+    """Enforce -b on device-explored loops: host-side the bound fires when
+    a state is SELECTED at a JUMPDEST, but lanes that looped on device
+    come back frozen at a trap op, so the selection-time check never sees
+    them. Run the same repeating-cycle test on the lifted jumpdest traces
+    here and drop states beyond the bound."""
+    from mythril_tpu.laser.evm.strategy.extensions.bounded_loops import (
+        BoundedLoopsStrategy,
+        JumpdestCountAnnotation,
+    )
+    from mythril_tpu.laser.evm.transaction.transaction_models import (
+        ContractCreationTransaction,
+    )
+
+    bounded = laser.strategy
+    while bounded is not None and not isinstance(bounded, BoundedLoopsStrategy):
+        bounded = getattr(bounded, "super_strategy", None)
+    if bounded is None:
+        return states
+
+    kept = []
+    for state in states:
+        annotations = list(state.get_annotations(JumpdestCountAnnotation))
+        trace = annotations[0].trace if annotations else []
+        if len(trace) >= 4:
+            count = _suffix_cycle_count(trace)
+            bound = bounded.bound
+            if isinstance(state.current_transaction, ContractCreationTransaction):
+                bound = max(8, bound)
+            if count > bound:
+                bounded.skipped += 1
+                continue
+        kept.append(state)
+    return kept
+
+
+def _suffix_cycle_count(trace: List[int]) -> int:
+    """Largest number of contiguous repeats of any cycle ending the trace.
+
+    The host strategy's pair-distance heuristic
+    (strategy/extensions/bounded_loops.py) assumes one entry PER
+    INSTRUCTION; the device ring records jumpdests only, so the repeat
+    count is computed directly on suffix periods here."""
+    n = len(trace)
+    best = 1
+    for period in range(1, n // 2 + 1):
+        window = trace[n - period :]
+        repeats = 1
+        while (
+            n - (repeats + 1) * period >= 0
+            and trace[n - (repeats + 1) * period : n - repeats * period] == window
+        ):
+            repeats += 1
+        if repeats > best:
+            best = repeats
+    return best
+
+
+def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
+    """Drain the work list through alternating host/device phases.
+
+    With ``track_gas`` (the concolic/conformance mode, reference surface
+    svm.py exec(track_gas=True)) the states that halt are collected and
+    returned so gas bounds and post-state can be asserted."""
     strategy = find_tpu_strategy(laser.strategy)
     cfg = strategy.batch_cfg
     host_ops = host_op_bytes(laser)
     seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
+    final_states: List[GlobalState] = []
 
     while laser.work_list:
         if (
@@ -183,32 +344,38 @@ def exec_batch(laser, track_gas=False) -> None:
             <= datetime.now()
         ):
             log.debug("Hit execution timeout in tpu-batch loop, returning.")
-            return
+            # keep the in-flight frontier: the host loop's timeout path
+            # returns the currently selected state too
+            return final_states + laser.work_list[:] if track_gas else None
 
-        # ---------------- phase A: one host instruction per state
-        pending = laser.work_list[:]
-        del laser.work_list[:]
-        produced: List[tuple] = []  # (new_states, op_code) per executed state
+        # ---------------- phase A: one host instruction per state.
+        # Selection goes through the STRATEGY iterator, not the raw work
+        # list: decorator strategies (BoundedLoops jumpdest-trace bounds,
+        # Coverage preference) filter and annotate at selection time
+        # exactly as in the host loop (reference svm.py exec).
+        pending = list(laser.strategy)
+        produced: List[tuple] = []  # (state, new_states, op_code)
         for global_state in pending:
-            if global_state.mstate.depth >= laser.max_depth:
-                continue
             try:
                 new_states, op_code = laser.execute_state(global_state)
             except NotImplementedError:
                 log.debug("Encountered unimplemented instruction")
                 continue
-            produced.append((new_states, op_code))
+            produced.append((global_state, new_states, op_code))
         # feasibility for the whole successor frontier in one device call
-        filter_feasible([s for states, _ in produced for s in states])
+        filter_feasible([s for _, states, _ in produced for s in states])
         survivors = []
-        for new_states, op_code in produced:
+        for global_state, new_states, op_code in produced:
             new_states = [
                 state
                 for state in new_states
                 if state.world_state.constraints.is_possible
             ]
             laser.manage_cfg(op_code, new_states)
-            survivors.extend(new_states)
+            if new_states:
+                survivors.extend(new_states)
+            elif track_gas:
+                final_states.append(global_state)
             laser.total_states += len(new_states)
         if not survivors:
             continue
@@ -231,9 +398,25 @@ def exec_batch(laser, track_gas=False) -> None:
             continue
 
         cb, st = bridge.finish()
-        out = run(cb, default_env(), st, max_steps=4096)
+        out = _run_device(cb, st, cfg)
         strategy.device_rounds += 1
         strategy.device_steps_retired += int(np.asarray(out.steps).sum())
+
+        # measurement parity: instructions retired on device feed the same
+        # coverage accounting the host's execute_state hook does
+        if laser._device_coverage_hooks:
+            visited = np.asarray(out.visited)
+            code_ids = np.asarray(out.code_id)
+            alive_np = np.asarray(out.alive)
+            for code_id, code_bytes in enumerate(bridge.codes):
+                lanes_mask = alive_np & (code_ids == code_id)
+                if not lanes_mask.any():
+                    continue
+                offsets = np.nonzero(visited[lanes_mask].any(axis=0))[0]
+                if offsets.size == 0:
+                    continue
+                for hook in laser._device_coverage_hooks:
+                    hook(code_bytes.hex(), offsets.tolist())
 
         alive = np.asarray(out.alive)
         status = np.asarray(out.status)
@@ -251,6 +434,9 @@ def exec_batch(laser, track_gas=False) -> None:
                 log.warning("unpack failed for lane %d: %s", lane, e)
                 continue
             resumed_states.append(resumed)
-        laser.work_list.extend(filter_feasible(resumed_states))
+        laser.work_list.extend(
+            _apply_loop_bound(laser, filter_feasible(resumed_states))
+        )
         # device-born forks add to the explored-state count
         laser.total_states += max(0, int(alive.sum()) - len(packed_states))
+    return final_states if track_gas else None

@@ -79,6 +79,11 @@ class Env(NamedTuple):
     blockhash: jnp.ndarray  # single modeled hash for BLOCKHASH
 
 
+# depth of the on-device JUMPDEST ring buffer: bounded-loop detection sees
+# the last JD_RING jumpdests a lane visited (suffix cycles up to ~JD_RING/2)
+JD_RING = 64
+
+
 class StateBatch(NamedTuple):
     alive: jnp.ndarray  # bool[L] lane holds a state
     status: jnp.ndarray  # i32[L] RUNNING..TRAP
@@ -104,6 +109,9 @@ class StateBatch(NamedTuple):
     address: jnp.ndarray  # u32[L, 16]
     balance: jnp.ndarray  # u32[L, 16] self-balance
     steps: jnp.ndarray  # i32[L] instructions retired in this lane
+    visited: jnp.ndarray  # bool[L, code_len] byte-pcs retired (coverage)
+    jd_ring: jnp.ndarray  # i32[L, JD_RING] last JUMPDEST byte-pcs (loop bounds)
+    jd_cnt: jnp.ndarray  # i32[L] total JUMPDESTs retired
     # ---- symbolic layer (laser/tpu/symtape.py). Tags are 1-based tape
     # ids; 0 = concrete (the word/byte planes are authoritative).
     stack_sym: jnp.ndarray  # i32[L, S]
@@ -167,6 +175,9 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "address": word,
         "balance": word,
         "steps": ((L,), np.int32),
+        "visited": ((L, cfg.code_len), np.bool_),
+        "jd_ring": ((L, JD_RING), np.int32),
+        "jd_cnt": ((L,), np.int32),
         "stack_sym": ((L, S), np.int32),
         "tape_op": ((L, T), np.int32),
         "tape_a": ((L, T), np.int32),
@@ -205,8 +216,14 @@ def make_code_bank(codes, code_len: int, host_ops=None, freeze_errors=False) -> 
     """Host helper: list of bytes objects -> CodeBank (pads / analyses).
 
     ``host_ops`` is an optional iterable of opcode bytes that must
-    freeze-trap back to the host (hybrid-loop mode)."""
-    n = len(codes)
+    freeze-trap back to the host (hybrid-loop mode).
+
+    The row count pads to a power of two so the jitted step kernel sees a
+    stable CodeBank shape across analyses (one compile per bucket, not one
+    per distinct contract count)."""
+    n = 1
+    while n < len(codes):
+        n <<= 1
     code = np.zeros((n, code_len), dtype=np.uint8)
     lens = np.zeros((n,), dtype=np.int32)
     jd = np.zeros((n, code_len), dtype=bool)
@@ -324,6 +341,9 @@ def _fill_lane(
     np_batch["address"][lane] = words.from_int(address)
     np_batch["balance"][lane] = words.from_int(balance)
     np_batch["steps"][lane] = 0
+    np_batch["visited"][lane] = False
+    np_batch["jd_ring"][lane] = 0
+    np_batch["jd_cnt"][lane] = 0
     # symbolic layer resets
     for f in (
         "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_len",

@@ -338,7 +338,7 @@ class DeviceBridge:
 
         # --- storage
         storage = account.storage
-        concrete_world = not storage._standard_storage.__class__.__name__ == "Array"
+        concrete_world = not storage._backing.__class__.__name__ == "Array"
         np_batch["storage_symbolic"][lane] = not concrete_world
         entries = list(storage.printable_storage.items())
         if len(entries) > self.cfg.storage_slots:
@@ -568,10 +568,20 @@ class DeviceBridge:
 
                 v = If(y == 0, zero, SRem(x, y))
             elif op == symtape.OP_EXP:
-                # device EXP nodes are rare; carry an uninterpreted leaf
-                v = symbol_factory.BitVecSym(f"devexp_{lane}_{i}", 256)
+                # no closed QF_BV form: mirror the HOST's uninterpreted
+                # symbol naming (instructions.py exp_), so the same operand
+                # pair lifts to the SAME symbol on either interpreter —
+                # host-equivalent semantics, not a fresh leaf per occurrence
+                v = symbol_factory.BitVecSym(
+                    "invhash(%s)**invhash(%s)"
+                    % (hash(simplify(x)), hash(simplify(y))),
+                    256,
+                )
             elif op == symtape.OP_SIGNEXT:
-                v = symbol_factory.BitVecSym(f"devsignext_{lane}_{i}", 256)
+                # exact: for position b < 32, shift the target byte's sign
+                # bit to the top and arithmetic-shift back down
+                t = (symbol_factory.BitVecVal(31, 256) - x) * symbol_factory.BitVecVal(8, 256)
+                v = If(ULT(x, symbol_factory.BitVecVal(32, 256)), (y << t) >> t, y)
             elif op == symtape.OP_AND:
                 v = x & y
             elif op == symtape.OP_OR:
@@ -581,7 +591,15 @@ class DeviceBridge:
             elif op == symtape.OP_NOT:
                 v = ~x
             elif op == symtape.OP_BYTE:
-                v = symbol_factory.BitVecSym(f"devbyte_{lane}_{i}", 256)
+                # exact: byte i of the word, 0 for i >= 32
+                from mythril_tpu.smt import LShR as _LShR
+
+                shift = (symbol_factory.BitVecVal(31, 256) - x) * symbol_factory.BitVecVal(8, 256)
+                v = If(
+                    ULT(x, symbol_factory.BitVecVal(32, 256)),
+                    _LShR(y, shift) & symbol_factory.BitVecVal(0xFF, 256),
+                    zero,
+                )
             elif op == symtape.OP_SHL:
                 v = y << x
             elif op == symtape.OP_SHR:
@@ -701,6 +719,32 @@ class DeviceBridge:
         spent = max(0, min(packed_gas, 0xFFFFFFFF) - int(np.asarray(st.gas_left)[lane]))
         gs.mstate.min_gas_used += spent
         gs.mstate.max_gas_used += int(np.asarray(st.gas_spent_max)[lane])
+
+        # device-retired instructions count toward path depth, so --max-depth
+        # bounds device-explored paths exactly like host-explored ones
+        gs.mstate.depth += int(np.asarray(st.steps)[lane])
+
+        # JUMPDESTs retired on device extend the per-state jumpdest trace,
+        # so BoundedLoopsStrategy bounds device-explored loops too. The
+        # device keeps the last JD_RING entries — the suffix is exactly
+        # what the repeating-cycle detector inspects.
+        jd_cnt = int(np.asarray(st.jd_cnt)[lane])
+        if jd_cnt:
+            from mythril_tpu.laser.evm.strategy.extensions.bounded_loops import (
+                JumpdestCountAnnotation,
+            )
+            from mythril_tpu.laser.tpu.batch import JD_RING
+
+            ring = np.asarray(st.jd_ring)[lane]
+            n = min(jd_cnt, JD_RING)
+            entries = [int(ring[k % JD_RING]) for k in range(jd_cnt - n, jd_cnt)]
+            annotations = list(gs.get_annotations(JumpdestCountAnnotation))
+            if annotations:
+                annotation = annotations[0]
+            else:
+                annotation = JumpdestCountAnnotation()
+                gs.annotate(annotation)
+            annotation.trace.extend(entries)
 
         # path conditions + keccak side conditions
         for cond in self.lane_constraints(st, lane, values, side):

@@ -24,6 +24,8 @@ from mythril_tpu.smt.solver import pysat
 from mythril_tpu.smt.solver.bitblast import Blaster, BlastError
 from mythril_tpu.smt.solver.native import make_sat
 from mythril_tpu.smt.solver.preprocess import TheoryEliminator
+
+
 from mythril_tpu.smt.terms import EvalEnv, Term
 
 log = logging.getLogger(__name__)
@@ -33,6 +35,24 @@ log = logging.getLogger(__name__)
 CLAUSE_LIMIT = 40_000_000
 
 
+class LazyCongruenceEliminator(TheoryEliminator):
+    """Theory eliminator WITHOUT eager pairwise Ackermann axioms.
+
+    The process-global core accumulates selects/applications from the
+    whole analysis; eager pairwise congruence would grow quadratically
+    (the round-3 host-engine regression). Instead the core repairs
+    congruence lazily: after a SAT answer, violated pairs among the
+    query-relevant entries get their axiom asserted and the query is
+    re-solved (CEGAR). UNSAT under missing axioms is still sound — the
+    formula without them is strictly weaker."""
+
+    def _select_congruence(self, entries, idx, var) -> None:
+        pass
+
+    def _apply_congruence(self, entries, args, var) -> None:
+        pass
+
+
 class IncrementalCore:
     def __init__(self) -> None:
         self._fresh_engine()
@@ -40,8 +60,9 @@ class IncrementalCore:
     def _fresh_engine(self) -> None:
         self.sat = make_sat()
         self.blaster = Blaster(self.sat)
-        self.elim = TheoryEliminator()
+        self.elim = LazyCongruenceEliminator()
         self._side_cursor = 0
+        self._congruence_axioms = set()  # (var_uid_a, var_uid_b) pairs done
         # rewritten-term uid -> frozenset of leaf symbol names (bv + bool)
         self._names_cache: Dict[int, FrozenSet[str]] = {}
         self.query_count = 0
@@ -136,46 +157,153 @@ class IncrementalCore:
                 value |= 1 << i
         return value
 
-    def extract_env(self, query_rws: List[Term]) -> EvalEnv:
-        """Build an EvalEnv restricted to symbols relevant to the query:
-        the query terms' leaves, plus — for every array/function any of
-        those leaves belongs to — all recorded Ackermann entries of that
-        array/function and their index terms' leaves (closed transitively,
-        so congruent reconstruction of store maps stays consistent)."""
-        assign = self.sat.model_copy()
+    @staticmethod
+    def _var_key(var_term: Term) -> Tuple[str, str, int]:
+        return ("bv", var_term.params[0], var_term.size)
+
+    def _relevance(self, query_rws: List[Term]):
+        """(relevant leaf set, relevant array names, relevant func names):
+        the query terms' leaves, transitively closed over the Ackermann
+        entries of every array/function any leaf belongs to."""
         relevant = set()
         for rw in query_rws:
             relevant.update(self._leaf_names(rw))
-
         info = self.elim.info
         included_arrays: Dict[str, bool] = {}
         included_funcs: Dict[str, bool] = {}
-
-        def _var_key(var_term: Term) -> Tuple[str, str, int]:
-            return ("bv", var_term.params[0], var_term.size)
-
         changed = True
         while changed:
             changed = False
             for name, entries in info.arrays.items():
                 if included_arrays.get(name):
                     continue
-                if any(_var_key(var) in relevant for _, var in entries):
+                if any(self._var_key(var) in relevant for _, var in entries):
                     included_arrays[name] = True
                     for idx_term, var_term in entries:
-                        relevant.add(_var_key(var_term))
+                        relevant.add(self._var_key(var_term))
                         relevant.update(self._leaf_names(idx_term))
                     changed = True
             for name, entries in info.funcs.items():
                 if included_funcs.get(name):
                     continue
-                if any(_var_key(var) in relevant for _, var in entries):
+                if any(self._var_key(var) in relevant for _, var in entries):
                     included_funcs[name] = True
                     for arg_terms, var_term in entries:
-                        relevant.add(_var_key(var_term))
+                        relevant.add(self._var_key(var_term))
                         for a in arg_terms:
                             relevant.update(self._leaf_names(a))
                     changed = True
+        return relevant, included_arrays, included_funcs
+
+    def _model_values(self, relevant) -> Tuple[Dict, Dict]:
+        assign = self.sat.model_copy()
+        bv_values: Dict = {}
+        bool_values: Dict = {}
+        blaster = self.blaster
+        for kind, name, size in relevant:
+            if kind == "bv":
+                bits = blaster.var_bits.get((name, size))
+                if bits is not None:
+                    word = self._read_word(bits, assign)
+                    bv_values[(name, size)] = word
+                    bv_values.setdefault(name, word)
+                continue
+            lit = blaster.bool_vars.get(name)
+            if lit is not None:
+                v = abs(lit)
+                val = assign[v] if v < len(assign) else -1
+                if val == 0:
+                    val = -1
+                bool_values[name] = (val == 1) if lit > 0 else (val == -1)
+        return bv_values, bool_values
+
+    # -- lazy congruence (CEGAR) ----------------------------------------------
+
+    def solve_checked(
+        self,
+        lits: List[int],
+        query_rws: List[Term],
+        timeout_ms: Optional[int] = None,
+        conflict_budget: Optional[int] = None,
+        max_repair_rounds: int = 24,
+    ) -> int:
+        """Solve under assumptions, repairing violated Ackermann
+        congruence among the query-relevant entries until the model is
+        consistent (or rounds run out -> UNKNOWN)."""
+        for _ in range(max_repair_rounds):
+            code = self.solve(
+                lits, timeout_ms=timeout_ms, conflict_budget=conflict_budget
+            )
+            if code != pysat.SAT:
+                return code
+            if not self._repair_congruence(query_rws):
+                return pysat.SAT
+        return pysat.UNKNOWN
+
+    def _repair_congruence(self, query_rws: List[Term]) -> bool:
+        """Assert axioms for congruence violations the current model shows
+        among relevant entries; True if anything was added."""
+        relevant, arrays, funcs = self._relevance(query_rws)
+        bv_values, bool_values = self._model_values(relevant)
+        env0 = EvalEnv(bv_values, bool_values, {}, {}, completion=True)
+        info = self.elim.info
+        repaired = False
+
+        for name in arrays:
+            by_index: Dict[int, Tuple[Term, Term, int]] = {}
+            for idx_term, var_term in info.arrays[name]:
+                idx_val = terms.evaluate(idx_term, env0)
+                var_val = bv_values.get(var_term.params[0], 0)
+                first = by_index.get(idx_val)
+                if first is None:
+                    by_index[idx_val] = (idx_term, var_term, var_val)
+                    continue
+                f_idx, f_var, f_val = first
+                if f_val == var_val:
+                    continue
+                pair = tuple(sorted((f_var.uid, var_term.uid)))
+                if pair in self._congruence_axioms:
+                    continue
+                self._congruence_axioms.add(pair)
+                self.blaster.assert_formula(
+                    terms.bool_or(
+                        terms.bool_not(terms.bool_eq(f_idx, idx_term)),
+                        terms.bool_eq(f_var, var_term),
+                    )
+                )
+                repaired = True
+        for name in funcs:
+            by_args: Dict[Tuple, Tuple[Tuple, Term, int]] = {}
+            for arg_terms, var_term in info.funcs[name]:
+                args_val = tuple(terms.evaluate(a, env0) for a in arg_terms)
+                var_val = bv_values.get(var_term.params[0], 0)
+                first = by_args.get(args_val)
+                if first is None:
+                    by_args[args_val] = (arg_terms, var_term, var_val)
+                    continue
+                f_args, f_var, f_val = first
+                if f_val == var_val:
+                    continue
+                pair = tuple(sorted((f_var.uid, var_term.uid)))
+                if pair in self._congruence_axioms:
+                    continue
+                self._congruence_axioms.add(pair)
+                same_args = terms.bool_and(
+                    *[terms.bool_eq(pa, a) for pa, a in zip(f_args, arg_terms)]
+                )
+                self.blaster.assert_formula(
+                    terms.bool_or(
+                        terms.bool_not(same_args), terms.bool_eq(f_var, var_term)
+                    )
+                )
+                repaired = True
+        return repaired
+
+    def extract_env(self, query_rws: List[Term]) -> EvalEnv:
+        """EvalEnv restricted to query-relevant symbols (congruent after
+        solve_checked's repair loop converged)."""
+        relevant, included_arrays, included_funcs = self._relevance(query_rws)
+        assign = self.sat.model_copy()
 
         bv_values = {}
         bool_values = {}
@@ -200,6 +328,7 @@ class IncrementalCore:
                 bool_values[name] = (val == 1) if lit > 0 else (val == -1)
 
         env0 = EvalEnv(bv_values, bool_values, {}, {}, completion=True)
+        info = self.elim.info
         arrays = {}
         for name in included_arrays:
             store = {}

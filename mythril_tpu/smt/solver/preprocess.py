@@ -46,6 +46,30 @@ class TheoryEliminator:
         self._fresh += 1
         return terms.bv_var("!%s!%d" % (prefix, self._fresh), size)
 
+    def _select_congruence(self, entries, idx: Term, var: Term) -> None:
+        """Eager pairwise congruence with earlier selects of the array.
+        Subclasses may defer this (model-driven lazy congruence) — the
+        quadratic axiom count is fine per query but not process-wide."""
+        for prev_idx, prev_var in entries:
+            self.side_conditions.append(
+                terms.bool_or(
+                    terms.bool_not(terms.bool_eq(prev_idx, idx)),
+                    terms.bool_eq(prev_var, var),
+                )
+            )
+
+    def _apply_congruence(self, entries, args, var: Term) -> None:
+        """Eager pairwise congruence with earlier applications of the UF."""
+        for prev_args, prev_var in entries:
+            same_args = terms.bool_and(
+                *[terms.bool_eq(pa, a) for pa, a in zip(prev_args, args)]
+            )
+            self.side_conditions.append(
+                terms.bool_or(
+                    terms.bool_not(same_args), terms.bool_eq(prev_var, var)
+                )
+            )
+
     def _select_base(self, base: Term, idx: Term) -> Term:
         """Ackermannize a select on a base array (array_var)."""
         key = (base.uid, idx.uid)
@@ -55,14 +79,7 @@ class TheoryEliminator:
         name = base.params[0]
         var = self._fresh_var("sel_" + name, base.size)
         entries = self.info.arrays.setdefault(name, [])
-        # pairwise congruence with earlier selects of the same array
-        for prev_idx, prev_var in entries:
-            self.side_conditions.append(
-                terms.bool_or(
-                    terms.bool_not(terms.bool_eq(prev_idx, idx)),
-                    terms.bool_eq(prev_var, var),
-                )
-            )
+        self._select_congruence(entries, idx, var)
         entries.append((idx, var))
         self.sel_vars[key] = var
         return var
@@ -101,15 +118,7 @@ class TheoryEliminator:
             else:
                 var = self._fresh_var("uf_" + name, rng)
                 entries = self.info.funcs.setdefault(name, [])
-                for prev_args, prev_var in entries:
-                    same_args = terms.bool_and(
-                        *[terms.bool_eq(pa, a) for pa, a in zip(prev_args, args)]
-                    )
-                    self.side_conditions.append(
-                        terms.bool_or(
-                            terms.bool_not(same_args), terms.bool_eq(prev_var, var)
-                        )
-                    )
+                self._apply_congruence(entries, args, var)
                 entries.append((args, var))
                 self.app_vars[key] = var
                 out = var

@@ -117,8 +117,8 @@ class BaseSolver:
         if lowered is None:
             return unknown
         lits, rws = lowered
-        code = core.solve(
-            lits, timeout_ms=self.timeout, conflict_budget=self.conflict_budget
+        code = core.solve_checked(
+            lits, rws, timeout_ms=self.timeout, conflict_budget=self.conflict_budget
         )
         if code == pysat.SAT:
             self._model_env = core.extract_env(rws)
@@ -177,12 +177,15 @@ class Optimize(BaseSolver):
             log.warning("bit-blasting objective failed: %s", e)
             obj_words, obj_rws = [], []
 
-        code = core.solve(
-            lits, timeout_ms=remaining_ms(), conflict_budget=self.conflict_budget
+        env_rws = rws + obj_rws
+        code = core.solve_checked(
+            lits,
+            env_rws,
+            timeout_ms=remaining_ms(),
+            conflict_budget=self.conflict_budget,
         )
         if code != pysat.SAT:
             return _RESULT_BY_CODE[code]
-        env_rws = rws + obj_rws
         self._model_env = core.extract_env(env_rws)
         if not obj_words:
             return sat
@@ -205,8 +208,9 @@ class Optimize(BaseSolver):
                     cond = -blaster.w_ult(bound, obj_bits)  # obj <= mid
                 else:
                     cond = -blaster.w_ult(obj_bits, bound)  # obj >= mid
-                code = core.solve(
+                code = core.solve_checked(
                     lits + pins + [cond],
+                    env_rws,
                     timeout_ms=remaining_ms(),
                     conflict_budget=self.conflict_budget,
                 )

@@ -1,40 +1,44 @@
-"""Solver query statistics (reference surface:
-mythril/laser/smt/solver/solver_statistics.py — counts and times every
-solver check)."""
+"""Solver query accounting.
 
-import time
-from typing import Callable
+Parity surface: mythril/laser/smt/solver/solver_statistics.py — a
+process-wide counter/timer around every solver check, switched on by the
+analyzer and printed per contract."""
+
+from time import time
 
 from mythril_tpu.support.support_utils import Singleton
 
 
-def stat_smt_query(func: Callable):
-    """Measures statistics for annotated smt query check functions."""
-    stat_store = SolverStatistics()
-
-    def function_wrapper(*args, **kwargs):
-        if not stat_store.enabled:
-            return func(*args, **kwargs)
-        stat_store.query_count += 1
-        begin = time.time()
-        try:
-            return func(*args, **kwargs)
-        finally:
-            stat_store.solver_time += time.time() - begin
-
-    return function_wrapper
-
-
 class SolverStatistics(object, metaclass=Singleton):
-    """Solver Statistics Class: tracks the number and total duration of smt
-    queries."""
+    """Enabled -> counts queries and accumulates wall time."""
 
     def __init__(self):
         self.enabled = False
         self.query_count = 0
         self.solver_time = 0.0
 
+    def add_query_time(self, elapsed: float) -> None:
+        self.query_count += 1
+        self.solver_time += elapsed
+
     def __repr__(self):
         return "Query count: {} \nSolver time: {}".format(
             self.query_count, self.solver_time
         )
+
+
+def stat_smt_query(func):
+    """Wrap a solver check with the global statistics collector."""
+
+    stats = SolverStatistics()
+
+    def timed(*args, **kwargs):
+        if not stats.enabled:
+            return func(*args, **kwargs)
+        started = time()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            stats.add_query_time(time() - started)
+
+    return timed

@@ -49,6 +49,11 @@ class Term:
     # Identity-based hashing: hash-consing guarantees structural equality
     # implies identity, so the default object hash/eq are correct and fast.
 
+    def __reduce__(self):
+        # pickling rebuilds through _mk so loaded terms re-intern into the
+        # live hash-cons table (open-state checkpointing, SURVEY §5)
+        return (_mk, (self.op, self.sort, self.size, self.args, self.params))
+
     @property
     def is_const(self) -> bool:
         return self.op == "const" or self.op in ("true", "false")
@@ -162,10 +167,19 @@ def _binop(op: str, a: Term, b: Term, fold) -> Term:
 
 
 def bv_add(a: Term, b: Term) -> Term:
-    if b.is_const and b.value == 0:
-        return a
-    if a.is_const and a.value == 0:
-        return b
+    # canonicalize constants to the right so chains can reassociate
+    if a.is_const and not b.is_const:
+        a, b = b, a
+    if b.is_const:
+        if b.value == 0:
+            return a
+        # (x + c1) + c2 -> x + (c1 + c2): incremental index arithmetic
+        # (calldata/memory walks) must converge to one canonical node or
+        # structural-equality loop exits never fire
+        if a.op == "add" and a.args[1].is_const:
+            return bv_add(
+                a.args[0], bv_const((a.args[1].value + b.value) & mask(a.size), a.size)
+            )
     return _binop("add", a, b, lambda x, y, s: x + y)
 
 

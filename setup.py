@@ -1,0 +1,25 @@
+"""Package metadata for mythril_tpu.
+
+Parity surface: the reference's setup.py (console entry point `myth`,
+detection-module plugin entry-point group). Heavy dependencies are
+intentionally NOT pinned here: jax is required, z3 is NOT (the SMT stack
+is in-repo), plyvel/solc are optional integrations discovered at runtime.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="mythril-tpu",
+    version="0.1.0",
+    description="TPU-native security analysis tool for EVM bytecode",
+    packages=find_packages(exclude=("tests", "tests.*")),
+    include_package_data=True,
+    python_requires=">=3.8",
+    install_requires=[
+        "jax",
+        "numpy",
+    ],
+    entry_points={
+        "console_scripts": ["myth=mythril_tpu.interfaces.cli:main"],
+    },
+)

@@ -1,0 +1,179 @@
+"""Ethereum VMTests conformance harness.
+
+The official VMTests JSON fixtures (on disk at
+/root/reference/tests/laser/evm_testsuite/VMTests/) are replayed
+concolically — a fully concrete message call, no solver in the loop — and
+the post-state storage plus gas bounds are asserted. This is the
+ground-truth correctness anchor (SURVEY §4 item 1; reference template
+tests/laser/evm_testsuite/evm_test.py:104-187, re-designed here rather
+than ported).
+
+Two interpreters are checked against the same fixtures:
+  * host   — LaserEVM's Python instruction semantics (BFS strategy)
+  * hybrid — the tpu-batch host/device loop (TpuBatchStrategy), where the
+             batched step kernel retires whatever instructions it can and
+             traps the rest to the host. Fixture families the device
+             cannot pack simply degrade to the host path, so the hybrid
+             run is always defined; agreement is asserted on ALL of them.
+"""
+
+import json
+import os
+from glob import glob
+from typing import Dict, List, Optional, Tuple
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.evm import svm
+from mythril_tpu.laser.evm.state.account import Account
+from mythril_tpu.laser.evm.state.world_state import WorldState
+from mythril_tpu.laser.evm.strategy.basic import BreadthFirstSearchStrategy
+from mythril_tpu.laser.evm.transaction.concolic import execute_message_call
+from mythril_tpu.smt import symbol_factory
+
+VMTESTS_ROOT = "/root/reference/tests/laser/evm_testsuite/VMTests"
+
+# fixtures exercising behavior intentionally out of scope; each entry is
+# case_name -> reason
+SKIP = {
+    # the engine tracks gas as a [min, max] interval for symbolic analysis;
+    # the exact remaining-gas value GAS pushes is not modeled (the reference
+    # skip-lists the same family in its harness)
+    "gas0": "exact GAS introspection not modeled (interval gas)",
+    "gas1": "exact GAS introspection not modeled (interval gas)",
+}
+
+
+def _hx(s: str) -> int:
+    return int(s, 16)
+
+
+def load_cases(categories: Optional[List[str]] = None) -> List[Tuple[str, str, dict]]:
+    """[(category, case_name, case_dict)] for every fixture on disk."""
+    out = []
+    if not os.path.isdir(VMTESTS_ROOT):
+        return out
+    for cat_dir in sorted(glob(os.path.join(VMTESTS_ROOT, "vm*"))):
+        category = os.path.basename(cat_dir)
+        if categories and category not in categories:
+            continue
+        for path in sorted(glob(os.path.join(cat_dir, "*.json"))):
+            with open(path) as f:
+                doc = json.load(f)
+            for name, case in doc.items():
+                out.append((category, name, case))
+    return out
+
+
+def build_world(pre: Dict[str, dict]) -> WorldState:
+    world = WorldState()
+    for addr, fields in pre.items():
+        account = Account(
+            address=symbol_factory.BitVecVal(_hx(addr), 256),
+            code=Disassembly(fields["code"][2:]) if fields.get("code", "0x") != "0x" else None,
+            balances=world.balances,
+            concrete_storage=True,
+        )
+        account.set_balance(symbol_factory.BitVecVal(_hx(fields.get("balance", "0x0")), 256))
+        account.nonce = _hx(fields.get("nonce", "0x0"))
+        for k, v in fields.get("storage", {}).items():
+            account.storage[symbol_factory.BitVecVal(_hx(k), 256)] = symbol_factory.BitVecVal(
+                _hx(v), 256
+            )
+        world.put_account(account)
+    return world
+
+
+def make_laser(strategy_name: str) -> "svm.LaserEVM":
+    if strategy_name == "hybrid":
+        from mythril_tpu.laser.tpu.backend import TpuBatchStrategy
+
+        return svm.LaserEVM(
+            strategy=TpuBatchStrategy,
+            max_depth=8192,
+            execution_timeout=60,
+            transaction_count=1,
+            requires_statespace=False,
+        )
+    return svm.LaserEVM(
+        strategy=BreadthFirstSearchStrategy,
+        max_depth=8192,
+        execution_timeout=60,
+        transaction_count=1,
+        requires_statespace=False,
+    )
+
+
+def run_case(case: dict, strategy_name: str = "host"):
+    """Replay one fixture; returns the final (halted) global states."""
+    laser = make_laser(strategy_name)
+    laser.time = __import__("datetime").datetime.now()
+    world = build_world(case["pre"])
+    laser.open_states = [world]
+    exec_env = case["exec"]
+    env = case.get("env", {})
+    block_env = {}
+    for fixture_key, our_key in (
+        ("currentNumber", "number"),
+        ("currentTimestamp", "timestamp"),
+        ("currentCoinbase", "coinbase"),
+        ("currentDifficulty", "difficulty"),
+        ("currentBaseFee", "basefee"),
+    ):
+        if fixture_key in env:
+            block_env[our_key] = _hx(env[fixture_key])
+    final_states = execute_message_call(
+        laser,
+        callee_address=symbol_factory.BitVecVal(_hx(exec_env["address"]), 256),
+        caller_address=symbol_factory.BitVecVal(_hx(exec_env["caller"]), 256),
+        origin_address=symbol_factory.BitVecVal(_hx(exec_env["origin"]), 256),
+        code=exec_env["code"][2:],
+        data=bytes.fromhex(exec_env["data"][2:]),
+        gas_limit=_hx(exec_env["gas"]),
+        gas_price=_hx(exec_env["gasPrice"]),
+        value=_hx(exec_env["value"]),
+        track_gas=True,
+        block_env=block_env,
+    )
+    return final_states or []
+
+
+def storage_of(state, addr: int) -> Dict[int, int]:
+    """Concrete storage content of an account in a final state."""
+    world = state.world_state
+    account = world.accounts.get(addr)
+    if account is None:
+        return {}
+    out = {}
+    for key, value in account.storage.printable_storage.items():
+        kv = getattr(key, "value", None)
+        vv = getattr(value, "value", None)
+        if kv is not None and vv is not None:
+            out[kv] = vv
+    return out
+
+
+def assert_case(case: dict, final_states: List) -> None:
+    post = case.get("post")
+    if post is None:
+        # expected-failure fixture: the engine must survive it without
+        # producing a committed post-state (failed paths may linger in
+        # final_states pre-revert; svm reverts the WORLD state on failure,
+        # which the multi-tx tests cover — here absence of 'post' just
+        # means no post-state assertions apply)
+        return
+
+    assert final_states, "no final state for a fixture with post-state"
+    # the concolic run of a concrete tx should produce exactly one halt path
+    state = final_states[0]
+    for addr, fields in post.items():
+        expect = {_hx(k): _hx(v) for k, v in fields.get("storage", {}).items() if _hx(v) != 0}
+        got = {k: v for k, v in storage_of(state, _hx(addr)).items() if v != 0}
+        assert got == expect, (
+            f"storage mismatch for {addr}: expected {expect}, got {got}"
+        )
+
+    if "gas" in case:
+        used = _hx(case["exec"]["gas"]) - _hx(case["gas"])
+        lo = state.mstate.min_gas_used
+        hi = state.mstate.max_gas_used
+        assert lo <= used <= hi, f"gas bounds [{lo}, {hi}] exclude actual {used}"

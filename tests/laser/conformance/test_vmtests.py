@@ -1,0 +1,67 @@
+"""Ethereum VMTests conformance (SURVEY §4 item 1 — the correctness anchor).
+
+Every fixture replays concolically through the host interpreter; a
+category-spanning subset also replays through the tpu-batch hybrid loop,
+asserting the two interpreters agree with the official post-states. Set
+MYTHRIL_TPU_CONFORMANCE=full to run the hybrid differential on the whole
+corpus."""
+
+import os
+
+import pytest
+
+import mythril_tpu.laser.tpu.backend as backend
+from mythril_tpu.laser.tpu.batch import BatchConfig
+from tests.laser.conformance import harness
+
+ALL_CASES = harness.load_cases()
+
+HYBRID_FULL = os.environ.get("MYTHRIL_TPU_CONFORMANCE") == "full"
+# every Nth fixture per category: spans all categories without paying the
+# full corpus cost in the default suite run
+HYBRID_STRIDE = 1 if HYBRID_FULL else 25
+
+_seen_cat_counts = {}
+HYBRID_CASES = []
+for _cat, _name, _case in ALL_CASES:
+    idx = _seen_cat_counts.get(_cat, 0)
+    _seen_cat_counts[_cat] = idx + 1
+    if idx % HYBRID_STRIDE == 0:
+        HYBRID_CASES.append((_cat, _name, _case))
+
+SMALL_CFG = BatchConfig(
+    lanes=16,
+    stack_slots=32,
+    memory_bytes=1024,
+    calldata_bytes=256,
+    storage_slots=16,
+    code_len=2048,
+    tape_slots=128,
+    path_slots=32,
+    mem_sym_slots=8,
+)
+
+
+@pytest.fixture()
+def small_batch(monkeypatch):
+    monkeypatch.setattr(backend, "DEFAULT_BATCH_CFG", SMALL_CFG)
+
+
+def _ids(cases):
+    return [f"{cat}::{name}" for cat, name, _ in cases]
+
+
+@pytest.mark.parametrize("category,name,case", ALL_CASES, ids=_ids(ALL_CASES))
+def test_vmtest_host(category, name, case):
+    if name in harness.SKIP:
+        pytest.skip(harness.SKIP[name])
+    final_states = harness.run_case(case, "host")
+    harness.assert_case(case, final_states)
+
+
+@pytest.mark.parametrize("category,name,case", HYBRID_CASES, ids=_ids(HYBRID_CASES))
+def test_vmtest_hybrid_differential(category, name, case, small_batch):
+    if name in harness.SKIP:
+        pytest.skip(harness.SKIP[name])
+    final_states = harness.run_case(case, "hybrid")
+    harness.assert_case(case, final_states)

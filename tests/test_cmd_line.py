@@ -1,0 +1,107 @@
+"""CLI end-to-end: shell out to `myth` and grep stdout (reference surface:
+tests/cmd_line_test.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mythril_tpu.disassembler.asm import assemble
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MYTH = os.path.join(REPO, "myth")
+
+# CALLVALUE; SSTORE; CALLER SELFDESTRUCT — an unprotected-selfdestruct target
+RUNTIME = assemble("CALLVALUE\nPUSH1 0x00\nSSTORE\nCALLER\nSELFDESTRUCT").hex()
+
+
+def creation_of(runtime_hex: str) -> str:
+    n = len(runtime_hex) // 2
+    src = (
+        f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+        "PUSH1 0x00\nRETURN\ncode:"
+    )
+    return assemble(src).hex() + runtime_hex
+
+
+def myth(*argv, timeout=420):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, MYTH, *argv],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+    )
+    return proc
+
+
+def test_version():
+    out = myth("version").stdout
+    assert "Mythril-TPU version v" in out
+
+
+def test_version_json():
+    out = myth("version", "-o", "json").stdout
+    assert json.loads(out)["version_str"].startswith("v")
+
+
+def test_list_detectors():
+    out = myth("list-detectors").stdout
+    assert "EtherThief" in out and "TxOrigin" in out
+
+
+def test_function_to_hash():
+    out = myth("function-to-hash", "transfer(address,uint256)").stdout
+    assert out.strip() == "0xa9059cbb"
+
+
+def test_hash_to_address():
+    out = myth(
+        "hash-to-address",
+        "0x0000000000000000000000001234567890123456789012345678901234567890",
+    ).stdout
+    assert out.strip() == "0x1234567890123456789012345678901234567890"
+
+
+def test_disassemble_code():
+    out = myth("disassemble", "-c", "0x6001600101", "--bin-runtime").stdout
+    assert "PUSH1" in out and "ADD" in out
+
+
+def test_no_input_error_json():
+    proc = myth("analyze", "-o", "json")
+    data = json.loads(proc.stdout)
+    assert data["success"] is False
+    assert "No input bytecode" in data["error"]
+    assert proc.returncode == 1
+
+
+def test_analyze_bytecode_text():
+    proc = myth(
+        "analyze",
+        "-c", creation_of(RUNTIME),
+        "--no-onchain-data", "-t", "1",
+        "--execution-timeout", "120",
+    )
+    assert "SWC ID: 106" in proc.stdout
+
+
+def test_analyze_bytecode_json_tpu_batch():
+    proc = myth(
+        "analyze",
+        "-c", creation_of(RUNTIME),
+        "--no-onchain-data", "-t", "1",
+        "--strategy", "tpu-batch",
+        "--lanes", "16",
+        "--execution-timeout", "240",
+        "-o", "json",
+    )
+    data = json.loads(proc.stdout)
+    assert data["success"] is True
+    swcs = {issue["swc-id"] for issue in data["issues"]}
+    assert "106" in swcs
