@@ -88,6 +88,7 @@ class SymExecWrapper:
         run_analysis_modules: bool = True,
         enable_coverage_strategy: bool = False,
         custom_modules_directory: str = "",
+        checkpoint_dir: Optional[str] = None,
     ):
         # every analysis starts from a fresh incremental solver core:
         # clause-database growth from prior contracts/runs in the same
@@ -136,6 +137,10 @@ class SymExecWrapper:
         plugin_loader.load(coverage_plugin)
         if not disable_dependency_pruning:
             plugin_loader.load(PluginFactory.build_dependency_pruner_plugin())
+        if checkpoint_dir:
+            from mythril_tpu.support.checkpoint import CheckpointPlugin
+
+            plugin_loader.load(CheckpointPlugin(checkpoint_dir))
 
         if run_analysis_modules:
             detectors = ModuleLoader().get_detection_modules(

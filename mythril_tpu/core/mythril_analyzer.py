@@ -39,6 +39,7 @@ class MythrilAnalyzer:
         solver_timeout: Optional[int] = None,
         enable_coverage_strategy: bool = False,
         custom_modules_directory: str = "",
+        checkpoint_dir: Optional[str] = None,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
@@ -54,6 +55,7 @@ class MythrilAnalyzer:
         self.disable_dependency_pruning = disable_dependency_pruning
         self.enable_coverage_strategy = enable_coverage_strategy
         self.custom_modules_directory = custom_modules_directory
+        self.checkpoint_dir = checkpoint_dir
         analysis_args.set_loop_bound(loop_bound)
         analysis_args.set_solver_timeout(solver_timeout)
 
@@ -69,6 +71,7 @@ class MythrilAnalyzer:
     def _wrapper_args(self, **overrides) -> dict:
         """The SymExecWrapper keyword set every command shares."""
         args = dict(
+            checkpoint_dir=self.checkpoint_dir,
             dynloader=self._make_dynloader(),
             max_depth=self.max_depth,
             execution_timeout=self.execution_timeout,

@@ -94,6 +94,11 @@ def add_analysis_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("--custom-modules-directory", default="", help="extra detection modules directory")
     group.add_argument("-q", "--query-signature", action="store_true", help="look up selectors on 4byte.directory")
     group.add_argument("--lanes", type=int, default=None, help="tpu-batch: device lanes per round")
+    group.add_argument(
+        "--checkpoint-dir",
+        metavar="DIR",
+        help="write an open-state checkpoint after every transaction round",
+    )
 
 
 # ------------------------------------------------------------------ plumbing
@@ -171,6 +176,7 @@ def run_analyze(args) -> None:
         enable_coverage_strategy=args.enable_coverage_strategy,
         custom_modules_directory=args.custom_modules_directory,
         use_onchain_data=not args.no_onchain_data,
+        checkpoint_dir=args.checkpoint_dir,
     )
 
     if args.graph:

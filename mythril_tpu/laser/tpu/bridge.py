@@ -569,12 +569,16 @@ class DeviceBridge:
                 v = If(y == 0, zero, SRem(x, y))
             elif op == symtape.OP_EXP:
                 # no closed QF_BV form: mirror the HOST's uninterpreted
-                # symbol naming (instructions.py exp_), so the same operand
-                # pair lifts to the SAME symbol on either interpreter —
-                # host-equivalent semantics, not a fresh leaf per occurrence
+                # symbol naming INCLUDING the tx-id prefix new_bitvec adds
+                # (instructions.py exp_), so the same operand pair lifts to
+                # the SAME symbol on either interpreter
                 v = symbol_factory.BitVecSym(
-                    "invhash(%s)**invhash(%s)"
-                    % (hash(simplify(x)), hash(simplify(y))),
+                    "%s_invhash(%s)**invhash(%s)"
+                    % (
+                        seed.current_transaction.id,
+                        hash(simplify(x)),
+                        hash(simplify(y)),
+                    ),
                     256,
                 )
             elif op == symtape.OP_SIGNEXT:

@@ -308,7 +308,6 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             None,
         ),
     )
-    exp_bytes = _byte_length(b)
 
     # ------------------------------------------------------------------
     # symbolic ALU: any tagged operand of a mapped opcode allocates one

@@ -90,14 +90,14 @@ def make_laser(strategy_name: str) -> "svm.LaserEVM":
         return svm.LaserEVM(
             strategy=TpuBatchStrategy,
             max_depth=8192,
-            execution_timeout=60,
+            execution_timeout=180,
             transaction_count=1,
             requires_statespace=False,
         )
     return svm.LaserEVM(
         strategy=BreadthFirstSearchStrategy,
         max_depth=8192,
-        execution_timeout=60,
+        execution_timeout=180,
         transaction_count=1,
         requires_statespace=False,
     )

@@ -71,7 +71,7 @@ def test_exec_batch_runs_sharded_over_virtual_mesh(mesh_on):
         contract,
         address=0x1234,
         strategy="tpu-batch",
-        execution_timeout=240,
+        execution_timeout=480,
         transaction_count=1,
         max_depth=64,
     )

@@ -26,7 +26,7 @@ def creation_of(runtime_hex: str) -> str:
     return assemble(src).hex() + runtime_hex
 
 
-def myth(*argv, timeout=420):
+def myth(*argv, timeout=900):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.run(
@@ -86,7 +86,7 @@ def test_analyze_bytecode_text():
         "analyze",
         "-c", creation_of(RUNTIME),
         "--no-onchain-data", "-t", "1",
-        "--execution-timeout", "120",
+        "--execution-timeout", "300",
     )
     assert "SWC ID: 106" in proc.stdout
 
@@ -98,7 +98,7 @@ def test_analyze_bytecode_json_tpu_batch():
         "--no-onchain-data", "-t", "1",
         "--strategy", "tpu-batch",
         "--lanes", "16",
-        "--execution-timeout", "240",
+        "--execution-timeout", "480",
         "-o", "json",
     )
     data = json.loads(proc.stdout)
