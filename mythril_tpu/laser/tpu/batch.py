@@ -26,7 +26,7 @@ from typing import NamedTuple, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from mythril_tpu.laser.tpu import words
+from mythril_tpu.laser.tpu import symtape, words
 
 RUNNING, STOPPED, RETURNED, REVERTED, ERROR, TRAP = range(6)
 
@@ -119,6 +119,8 @@ class StateBatch(NamedTuple):
     tape_a: jnp.ndarray  # i32[L, T]
     tape_b: jnp.ndarray  # i32[L, T]
     tape_imm: jnp.ndarray  # u32[L, T, 16]
+    tape_h1: jnp.ndarray  # u32[L, T] node identity hashes: the device
+    tape_h2: jnp.ndarray  # u32[L, T] CSE scan compares only these planes
     tape_len: jnp.ndarray  # i32[L]
     path_id: jnp.ndarray  # i32[L, P] branch-condition tape ids
     path_sign: jnp.ndarray  # bool[L, P] True = condition word != 0
@@ -183,6 +185,8 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "tape_a": ((L, T), np.int32),
         "tape_b": ((L, T), np.int32),
         "tape_imm": ((L, T, D), np.uint32),
+        "tape_h1": ((L, T), np.uint32),
+        "tape_h2": ((L, T), np.uint32),
         "tape_len": ((L,), np.int32),
         "path_id": ((L, P), np.int32),
         "path_sign": ((L, P), np.bool_),
@@ -291,6 +295,9 @@ def append_node(np_batch: dict, lane: int, op: int, a: int = 0, b: int = 0, imm=
     np_batch["tape_a"][lane, n] = a
     np_batch["tape_b"][lane, n] = b
     np_batch["tape_imm"][lane, n] = imm_row
+    h1, h2 = symtape.node_hash(op, a, b, imm_row, xp=np)
+    np_batch["tape_h1"][lane, n] = h1
+    np_batch["tape_h2"][lane, n] = h2
     np_batch["tape_len"][lane] = n + 1
     return n + 1
 
@@ -346,7 +353,8 @@ def _fill_lane(
     np_batch["jd_cnt"][lane] = 0
     # symbolic layer resets
     for f in (
-        "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_len",
+        "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1",
+        "tape_h2", "tape_len",
         "path_id", "path_sign", "path_len", "msym_off", "msym_id",
         "msym_used", "skey_sym", "sval_sym", "cdsize_sym", "caller_sym",
         "callvalue_sym", "origin_sym", "balance_sym",

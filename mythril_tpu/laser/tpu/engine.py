@@ -312,7 +312,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # ------------------------------------------------------------------
     # symbolic ALU: any tagged operand of a mapped opcode allocates one
     # tape node (the concrete operand, if any, rides inline in imm)
-    tapes = (st.tape_op, st.tape_a, st.tape_b, st.tape_imm, st.tape_len)
+    tapes = (
+        st.tape_op, st.tape_a, st.tape_b, st.tape_imm,
+        st.tape_h1, st.tape_h2, st.tape_len,
+    )
     sym_opt = jnp.asarray(symtape.SYM_OP)[op]
     sym_ar = jnp.asarray(symtape.SYM_ARITY)[op]
     alu_sym_mask = (
@@ -834,42 +837,58 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
 
     # ------------------------------------------------------------------
-    # memory writes (disjoint masks, one combined commit)
+    # memory writes (disjoint masks, one combined commit). MSTORE/MSTORE8
+    # write through per-lane windowed scatters (an out-of-range index drops
+    # the write); the full-width select-plus-gather formulation dominated
+    # the step's wall time on TPU. The copy ops keep the full-width form —
+    # their length is dynamic up to M — but are gated on "any lane copies
+    # this step", which makes them free in the common case.
     midx = jnp.arange(M, dtype=I32)[None, :]  # [1, M]
     mem = st.memory
     # MSTORE (symbolic values zero the byte range; the overlay holds them)
     wmask = committed & is_mstore
-    in_rng = (midx >= m_off[:, None]) & (midx < m_end[:, None])
     b_bytes = jnp.where(
         has_b[:, None], 0, words.to_bytes_be(b)
     ).astype(jnp.uint8)  # [L, 32]
-    gather = jnp.take_along_axis(
-        b_bytes, jnp.clip(midx - m_off[:, None], 0, 31), axis=-1
-    )
-    mem = jnp.where(wmask[:, None] & in_rng, gather, mem)
+    ms_pos = m_off[:, None] + g32[None, :]
+    ms_idx = jnp.where(wmask[:, None] & (ms_pos < M), ms_pos, M)
+    mem = mem.at[lane[:, None], ms_idx].set(b_bytes, mode="drop")
     # MSTORE8
     w8 = committed & is_mstore8
     low_byte = (b[:, 0] & 0xFF).astype(jnp.uint8)
-    mem = jnp.where(
-        w8[:, None] & (midx == m_off[:, None]), low_byte[:, None], mem
+    m8_idx = jnp.where(w8 & (m_off < M), m_off, M)
+    mem = mem.at[lane, m8_idx].set(low_byte, mode="drop")
+
+    # CALLDATACOPY / CODECOPY: dest=a32 off=b32 len=c32, zero-padded past
+    # the source's end
+    def copy_into(mem, wmask, src_rows_fn, src_len, cap):
+        def do(mem):
+            dst_rng = (midx >= a32[:, None]) & (midx < (a32 + c32)[:, None])
+            src_idx = midx - a32[:, None] + b32[:, None]
+            src_ok = (
+                (src_idx < src_len[:, None]) & b_fits[:, None] & (src_idx >= 0)
+            )
+            gathered = jnp.where(
+                src_ok,
+                jnp.take_along_axis(
+                    src_rows_fn(), jnp.clip(src_idx, 0, cap - 1), axis=1
+                ),
+                0,
+            )
+            return jnp.where(wmask[:, None] & dst_rng, gathered, mem)
+
+        return jax.lax.cond(jnp.any(wmask), do, lambda m: m, mem)
+
+    mem = copy_into(
+        mem, committed & is_cdcopy, lambda: st.calldata, st.calldata_len, C
     )
-    # CALLDATACOPY: dest=a32 off=b32 len=c32
-    wcd = committed & is_cdcopy
-    dst_rng = (midx >= a32[:, None]) & (midx < (a32 + c32)[:, None])
-    src_idx = midx - a32[:, None] + b32[:, None]
-    src_ok = (src_idx < st.calldata_len[:, None]) & b_fits[:, None] & (src_idx >= 0)
-    cd_gather = jnp.where(
-        src_ok, st.calldata[lane[:, None], jnp.clip(src_idx, 0, C - 1)], 0
+    mem = copy_into(
+        mem,
+        committed & is_codecopy,
+        lambda: cb.code[st.code_id],
+        my_code_len,
+        CL,
     )
-    mem = jnp.where(wcd[:, None] & dst_rng, cd_gather, mem)
-    # CODECOPY
-    wcc = committed & is_codecopy
-    csrc_idx = midx - a32[:, None] + b32[:, None]
-    csrc_ok = (csrc_idx < my_code_len[:, None]) & b_fits[:, None] & (csrc_idx >= 0)
-    cc_gather = jnp.where(
-        csrc_ok, cb.code[st.code_id[:, None], jnp.clip(csrc_idx, 0, CL - 1)], 0
-    )
-    mem = jnp.where(wcc[:, None] & dst_rng, cc_gather, mem)
 
     # ------------------------------------------------------------------
     # commit
@@ -878,7 +897,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         m = mask.reshape(mask.shape + (1,) * extra)
         return jnp.where(m, new, old)
 
-    tape_op_n, tape_a_n, tape_b_n, tape_imm_n, tape_len_n = tapes
+    (
+        tape_op_n, tape_a_n, tape_b_n, tape_imm_n,
+        tape_h1_n, tape_h2_n, tape_len_n,
+    ) = tapes
     status_mask = running  # status/trap bookkeeping applies to all running lanes
     nst = StateBatch(
         alive=st.alive,
@@ -917,10 +939,18 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         ),
         jd_cnt=st.jd_cnt + (committed & (op == 0x5B)),
         stack_sym=merge(stack_sym_after, st.stack_sym),
-        tape_op=merge(tape_op_n, st.tape_op),
-        tape_a=merge(tape_a_n, st.tape_a),
-        tape_b=merge(tape_b_n, st.tape_b),
-        tape_imm=merge(tape_imm_n, st.tape_imm),
+        # tape planes commit unconditionally: rows were written by masked
+        # per-lane scatters, and a non-committing lane reverts via tape_len
+        # alone — rows at or beyond tape_len are dead by invariant (the CSE
+        # scan masks on slot < tape_len; lift/pack read only len rows), so
+        # skipping the full-plane merge never exposes them. The [L, T, 16]
+        # imm merge was a dominant share of per-step HBM traffic.
+        tape_op=tape_op_n,
+        tape_a=tape_a_n,
+        tape_b=tape_b_n,
+        tape_imm=tape_imm_n,
+        tape_h1=tape_h1_n,
+        tape_h2=tape_h2_n,
         tape_len=merge(tape_len_n, st.tape_len),
         path_id=merge(new_path_id, st.path_id),
         path_sign=merge(new_path_sign, st.path_sign),

@@ -26,6 +26,7 @@ nodes (LT..ISZERO) are *word-valued* 0/1, matching how the EVM stacks
 them; the host bridge lifts them to If(cond, 1, 0) terms.
 """
 
+import jax
 import numpy as np
 import jax.numpy as jnp
 
@@ -87,31 +88,95 @@ for _byte, _top, _ar in [
     SYM_ARITY[_byte] = _ar
 
 
+def _mix(h, v, mul):
+    """One round of a murmur-style 32-bit mix (identical in numpy/jnp)."""
+    h = (h ^ v) * mul
+    return h ^ (h >> 16)
+
+
+def node_hash(op, a, b, imm, xp=jnp):
+    """Two independent 32-bit identity hashes of a node.
+
+    Shared by the device allocator and the host-side tape writers
+    (batch.append_node, the bridge packer) so both agree on row identity.
+    ``imm``'s digit axis is the last axis; broadcasting handles both the
+    batched [L] and the scalar host case.
+    """
+    op32 = xp.asarray(op).astype(xp.uint32)
+    a32 = xp.asarray(a).astype(xp.uint32)
+    b32 = xp.asarray(b).astype(xp.uint32)
+    imm32 = xp.asarray(imm).astype(xp.uint32)
+
+    def run(seed, mul):
+        mul = xp.uint32(mul)
+        h = _mix(op32 + xp.uint32(seed), a32, mul)
+        h = _mix(h, b32, mul)
+        for d in range(imm32.shape[-1]):
+            h = _mix(h, imm32[..., d], mul)
+        return h
+
+    if xp is np:
+        # u32 wraparound is the point; numpy warns on scalar overflow
+        with np.errstate(over="ignore"):
+            return run(0x811C9DC5, 0x9E3779B1), run(0x01000193, 0x85EBCA77)
+    return run(0x811C9DC5, 0x9E3779B1), run(0x01000193, 0x85EBCA77)
+
+
 def alloc(tapes, mask, op, a, b, imm):
     """Append one node per masked lane, with per-lane CSE.
 
-    ``tapes`` is ``(tape_op, tape_a, tape_b, tape_imm, tape_len)``;
-    ``op/a/b`` are [L] i32, ``imm`` is [L, 16] u32. Returns
+    ``tapes`` is ``(tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2,
+    tape_len)``; ``op/a/b`` are [L] i32, ``imm`` is [L, 16] u32. Returns
     ``(tapes', id1, ok)`` where ``id1`` [L] is the 1-based node id (an
     existing row if an identical node is already on the lane's tape) and
     ``ok`` is False where the tape is full (caller traps the lane).
     Lanes with ``mask`` False are untouched and get id1 = 0.
+
+    The CSE scan compares only the two u32 hash planes (the full
+    [L, T, 16] ``tape_imm`` compare dominated the step kernel's HBM
+    traffic); the single candidate row is then verified exactly, so a
+    hash collision can only cost a duplicate node, never soundness.
+
+    The whole body is gated on "any lane allocates": fully concrete
+    steps (and fully concrete workloads) skip the tape machinery
+    entirely, which keeps XLA from staging the tape planes through VMEM
+    every step.
     """
-    tape_op, tape_a, tape_b, tape_imm, tape_len = tapes
+    L = mask.shape[0]
+
+    def skip(operands):
+        tapes, _mask, _op, _a, _b, _imm = operands
+        return tapes, jnp.zeros((L,), jnp.int32), jnp.ones((L,), jnp.bool_)
+
+    def do(operands):
+        tapes, mask, op, a, b, imm = operands
+        return _alloc_impl(tapes, mask, op, a, b, imm)
+
+
+    return jax.lax.cond(
+        jnp.any(mask), do, skip, (tapes, mask, op, a, b, imm)
+    )
+
+
+def _alloc_impl(tapes, mask, op, a, b, imm):
+    tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2, tape_len = tapes
     L, T = tape_op.shape
     lane = jnp.arange(L)
     slot = jnp.arange(T)[None, :]
 
+    h1, h2 = node_hash(op, a, b, imm)
+
     live = slot < tape_len[:, None]
-    same = (
-        live
-        & (tape_op == op[:, None])
-        & (tape_a == a[:, None])
-        & (tape_b == b[:, None])
-        & jnp.all(tape_imm == imm[:, None, :], axis=-1)
+    same = live & (tape_h1 == h1[:, None]) & (tape_h2 == h2[:, None])
+    cand_any = jnp.any(same, axis=-1)
+    cand = jnp.argmax(same, axis=-1)
+    hit = (
+        cand_any
+        & (tape_op[lane, cand] == op)
+        & (tape_a[lane, cand] == a)
+        & (tape_b[lane, cand] == b)
+        & jnp.all(tape_imm[lane, cand] == imm, axis=-1)
     )
-    hit = jnp.any(same, axis=-1)
-    hit_idx = jnp.argmax(same, axis=-1)
 
     overflow = tape_len >= T
     do_new = mask & ~hit & ~overflow
@@ -125,11 +190,17 @@ def alloc(tapes, mask, op, a, b, imm):
     tape_op = put(tape_op, op)
     tape_a = put(tape_a, a)
     tape_b = put(tape_b, b)
+    tape_h1 = put(tape_h1, h1)
+    tape_h2 = put(tape_h2, h2)
     tape_imm = tape_imm.at[lane, widx].set(
         jnp.where(do_new[:, None], imm, tape_imm[lane, widx])
     )
     new_len = tape_len + do_new.astype(jnp.int32)
 
-    id1 = jnp.where(mask, jnp.where(hit, hit_idx, tape_len) + 1, 0)
+    id1 = jnp.where(mask, jnp.where(hit, cand, tape_len) + 1, 0)
     ok = ~mask | hit | ~overflow
-    return (tape_op, tape_a, tape_b, tape_imm, new_len), id1.astype(jnp.int32), ok
+    return (
+        (tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2, new_len),
+        id1.astype(jnp.int32),
+        ok,
+    )
