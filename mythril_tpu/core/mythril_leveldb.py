@@ -13,7 +13,7 @@ class MythrilLevelDB:
         """`leveldb-search` command: regex over stored contract code."""
 
         def search_callback(_, address, balance):
-            print("Address: " + address[0])
+            print("Address: " + address)
 
         try:
             self.leveldb_db.search(search, search_callback)

@@ -1,0 +1,96 @@
+"""Hash -> address account index over chaindata receipts.
+
+geth stores accounts keyed by keccak(address); recovering the address
+needs an index. This walks every block's stored receipts, collects
+contract-creation addresses, and persists ``AM + keccak(address) ->
+address`` mappings plus a progress marker so later runs resume
+incrementally. Parity: mythril/ethereum/interface/leveldb/
+accountindexing.py (AccountIndexer, BATCH_SIZE batching, fast-sync
+head handling).
+"""
+
+import logging
+
+from mythril_tpu.ethereum import rlp
+from mythril_tpu.exceptions import AddressNotFoundError
+
+log = logging.getLogger(__name__)
+
+BATCH_SIZE = 8 * 4096
+
+
+class AccountIndexer:
+    def __init__(self, eth_db):
+        self.db = eth_db
+        self.last_block = None
+        self.last_processed_block = None
+        self.update_if_needed()
+
+    def get_contract_by_hash(self, address_hash: bytes) -> bytes:
+        address = self.db.reader._get_address_by_hash(address_hash)
+        if address is None:
+            raise AddressNotFoundError
+        return address
+
+    def _process_batch(self, start_block: int):
+        """Creation addresses from receipts in [start, start+BATCH)."""
+        addresses = []
+        seen_any = False
+        for number in range(start_block, start_block + BATCH_SIZE):
+            block_hash = self.db.reader._get_block_hash(number)
+            if block_hash is None:
+                if not seen_any:
+                    return None  # ran off the chain head
+                break
+            seen_any = True
+            for receipt in self.db.reader._get_block_receipts(block_hash, number):
+                address = receipt.contract_address
+                if address and any(address):
+                    addresses.append(address)
+        return addresses
+
+    def update_if_needed(self) -> None:
+        head = self.db.reader._get_head_block()
+        if head is not None:
+            self.last_block = (
+                max(self.last_block, head.number)
+                if self.last_block is not None
+                else head.number
+            )
+        marker = self.db.reader._get_last_indexed_number()
+        if marker is not None:
+            self.last_processed_block = rlp.bytes_to_int(marker)
+
+        if self.last_block == 0:
+            # fast-sync head sits at 0; index until the hash lookup fails
+            self.last_block = 2_000_000_000
+        if self.last_block is None or (
+            self.last_processed_block is not None
+            and self.last_block <= self.last_processed_block
+        ):
+            return
+
+        number = (
+            self.last_processed_block + 1
+            if self.last_processed_block is not None
+            else 0
+        )
+        total = 0
+        while number <= self.last_block:
+            addresses = self._process_batch(number)
+            if addresses is None:
+                break
+            self.db.writer._start_writing()
+            for address in addresses:
+                self.db.writer._store_account_address(address)
+            self.db.writer._commit_batch()
+            total += len(addresses)
+            number = min(number + BATCH_SIZE, self.last_block + 1)
+            self.last_processed_block = number - 1
+            self.db.writer._set_last_indexed_number(self.last_processed_block)
+            log.info(
+                "indexed through block %d (%d addresses)",
+                self.last_processed_block,
+                total,
+            )
+        self.last_block = self.last_processed_block

@@ -1,143 +1,300 @@
-"""Raw geth-chaindata reader: code search and hash->address lookup.
+"""Go-Ethereum chaindata reader: state-trie accounts, code, storage,
+headers/bodies/receipts, hash->address search.
 
-Parity: mythril/ethereum/interface/leveldb/client.py — `LevelDBReader`
-(:46) walks the geth key schema (headers/bodies/receipts), `EthLevelDB`
-searches contract code and resolves code-hash -> address via the
-account index. A minimal RLP decoder is inlined (the reference leans on
-pyethereum; we avoid that dependency).
+Parity: mythril/ethereum/interface/leveldb/client.py (LevelDBReader /
+LevelDBWriter / EthLevelDB) and state.py (State / Account) — but built
+on the in-repo RLP codec and Merkle-Patricia reader (trie.py) instead
+of pyethereum, and runnable against either real LevelDB (plyvel) or a
+dict-backed MemoryDB fixture.
 """
 
 import binascii
 import logging
-from typing import Callable, List, Optional, Tuple
+import re
+from typing import Callable, Iterator, List, Optional, Tuple
 
+from mythril_tpu.ethereum import rlp
 from mythril_tpu.ethereum.evmcontract import EVMContract
 from mythril_tpu.ethereum.interface.leveldb.eth_db import EthDB
-from mythril_tpu.exceptions import AddressNotFoundError
+from mythril_tpu.ethereum.interface.leveldb.trie import BLANK_ROOT, TrieReader
+from mythril_tpu.exceptions import AddressNotFoundError, CriticalError
 from mythril_tpu.support.keccak import keccak256
 
 log = logging.getLogger(__name__)
 
-# geth schema (reference client.py:19-32)
-header_prefix = b"h"
-body_prefix = b"b"
-num_suffix = b"n"
-block_hash_prefix = b"H"
-block_receipts_prefix = b"r"
+# geth key schema (core/rawdb/schema.go; reference client.py:19-33)
+header_prefix = b"h"  # h + num(8BE) + hash -> header rlp
+body_prefix = b"b"  # b + num(8BE) + hash -> body rlp
+num_suffix = b"n"  # h + num(8BE) + n -> hash
+block_hash_prefix = b"H"  # H + hash -> num(8BE)
+block_receipts_prefix = b"r"  # r + num(8BE) + hash -> receipts rlp
 head_header_key = b"LastBlock"
-address_prefix = b"AM"  # account-index prefix (reference accountindexing.py)
+# index written by this framework (reference accountindexing.py)
+address_prefix = b"AM"  # AM + keccak(address) -> address
+address_mapping_head_key = b"accountMapping"
 
+BLANK_CODE_HASH = keccak256(b"")
 
-def rlp_decode(data: bytes):
-    """Minimal RLP decoder: bytes -> nested lists of bytes."""
-    items, _ = _rlp_decode_at(data, 0)
-    return items
-
-
-def _rlp_decode_at(data: bytes, idx: int):
-    prefix = data[idx]
-    if prefix < 0x80:
-        return bytes([prefix]), idx + 1
-    if prefix < 0xB8:
-        n = prefix - 0x80
-        return data[idx + 1 : idx + 1 + n], idx + 1 + n
-    if prefix < 0xC0:
-        lenlen = prefix - 0xB7
-        n = int.from_bytes(data[idx + 1 : idx + 1 + lenlen], "big")
-        start = idx + 1 + lenlen
-        return data[start : start + n], start + n
-    if prefix < 0xF8:
-        n = prefix - 0xC0
-    else:
-        lenlen = prefix - 0xF7
-        n = int.from_bytes(data[idx + 1 : idx + 1 + lenlen], "big")
-        idx += lenlen
-    end = idx + 1 + n
-    items = []
-    i = idx + 1
-    while i < end:
-        item, i = _rlp_decode_at(data, i)
-        items.append(item)
-    return items, end
+# header field offsets in the RLP list
+_H_PARENT, _H_STATE_ROOT, _H_NUMBER = 0, 3, 8
 
 
 def _format_block_number(number: int) -> bytes:
     return number.to_bytes(8, "big")
 
 
-class LevelDBReader:
-    """Read-level access to the geth chaindata schema (reference :46)."""
+class BlockHeader:
+    """Decoded header view over the raw RLP field list."""
 
-    def __init__(self, db: EthDB):
+    def __init__(self, fields: List[bytes]):
+        self.fields = fields
+        self.prevhash = fields[_H_PARENT] or None
+        self.state_root = fields[_H_STATE_ROOT]
+        self.number = rlp.bytes_to_int(fields[_H_NUMBER])
+
+
+class Receipt:
+    """Receipt-for-storage view: enough structure for the indexer."""
+
+    def __init__(self, fields: List):
+        # [state_root/status, cumulative_gas, bloom, tx_hash,
+        #  contract_address, logs, gas_used]
+        self.contract_address = (
+            fields[4] if len(fields) > 4 and isinstance(fields[4], bytes) else b""
+        )
+
+
+class Account:
+    """State-trie account: [nonce, balance, storage_root, code_hash]."""
+
+    def __init__(self, fields: List[bytes], db, address: bytes):
+        self.nonce = rlp.bytes_to_int(fields[0])
+        self.balance = rlp.bytes_to_int(fields[1])
+        self.storage_root = fields[2]
+        self.code_hash = fields[3]
+        self.db = db
+        self.address = address
+        self._storage_cache = {}
+
+    @classmethod
+    def blank(cls, db, address: bytes) -> "Account":
+        return cls([b"", b"", BLANK_ROOT, BLANK_CODE_HASH], db, address)
+
+    @property
+    def code(self) -> Optional[bytes]:
+        if self.code_hash == BLANK_CODE_HASH:
+            return None
+        return self.db.get(self.code_hash)
+
+    def get_storage_data(self, position: int) -> int:
+        if position not in self._storage_cache:
+            trie = TrieReader(self.db.get, self.storage_root)
+            raw = trie.get(keccak256(position.to_bytes(32, "big")))
+            self._storage_cache[position] = (
+                rlp.bytes_to_int(rlp.decode(raw)) if raw else 0
+            )
+        return self._storage_cache[position]
+
+    def is_blank(self) -> bool:
+        return (
+            self.nonce == 0 and self.balance == 0 and self.code_hash == BLANK_CODE_HASH
+        )
+
+
+class State:
+    """Secure-trie world state at one root."""
+
+    def __init__(self, db, root: bytes):
+        self.db = db
+        self.trie = TrieReader(db.get, root)
+        self.cache = {}
+
+    def get_account(self, address: bytes) -> Account:
+        if address in self.cache:
+            return self.cache[address]
+        raw = self.trie.get(keccak256(address))
+        if raw is None and len(address) == 32:
+            # support pre-hashed address keys
+            raw = self.trie.get(address)
+        account = (
+            Account(rlp.decode(raw), self.db, address)
+            if raw is not None
+            else Account.blank(self.db, address)
+        )
+        self.cache[address] = account
+        return account
+
+    def get_all_accounts(self) -> Iterator[Account]:
+        """Every account in the trie; addresses are the keccak'd keys
+        (resolve real addresses through the AM index)."""
+        for address_hash, raw in self.trie.items():
+            yield Account(rlp.decode(raw), self.db, address_hash)
+
+
+class LevelDBReader:
+    """Read access over the geth key schema."""
+
+    def __init__(self, db):
         self.db = db
         self.head_block_header = None
         self.head_state = None
 
-    def _get_head_block(self):
+    def _get_head_state(self) -> State:
+        if self.head_state is None:
+            self.head_state = State(self.db, self._get_head_block().state_root)
+        return self.head_state
+
+    def _get_account(self, address: str) -> Account:
+        raw_address = binascii.a2b_hex(address.replace("0x", ""))
+        return self._get_head_state().get_account(raw_address)
+
+    def _get_head_block(self) -> BlockHeader:
         if self.head_block_header is None:
             block_hash = self.db.get(head_header_key)
+            if block_hash is None:
+                raise CriticalError(
+                    "no LastBlock key: not a go-ethereum chaindata directory"
+                )
             num = self._get_block_number(block_hash)
-            self.head_block_header = self._get_block_header(block_hash, num)
+            header = self._get_block_header(block_hash, num)
+            # fast-sync chains miss state for recent heads: walk back to
+            # the newest header whose state root is present
+            while (
+                self.db.get(header.state_root) is None
+                and header.prevhash is not None
+            ):
+                block_hash = header.prevhash
+                num = self._get_block_number(block_hash)
+                if num is None:
+                    break
+                header = self._get_block_header(block_hash, num)
+            self.head_block_header = header
         return self.head_block_header
 
-    def _get_block_number(self, block_hash: bytes) -> bytes:
+    def _get_block_hash(self, number: int) -> Optional[bytes]:
+        return self.db.get(header_prefix + _format_block_number(number) + num_suffix)
+
+    def _get_block_number(self, block_hash: bytes) -> Optional[bytes]:
         return self.db.get(block_hash_prefix + block_hash)
 
-    def _get_block_header(self, block_hash: bytes, num: bytes):
-        header_key = header_prefix + num + block_hash
-        return rlp_decode(self.db.get(header_key))
+    def _get_block_header(self, block_hash: bytes, num: bytes) -> BlockHeader:
+        return BlockHeader(rlp.decode(self.db.get(header_prefix + num + block_hash)))
 
     def _get_address_by_hash(self, address_hash: bytes) -> Optional[bytes]:
         return self.db.get(address_prefix + address_hash)
 
-    def _get_account(self, address: bytes):
-        """State-trie account lookup is geth-version dependent; the
-        reference walks the secure trie (state.py) — here we only expose
-        the account-index path used by hash_to_address."""
-        raise NotImplementedError(
-            "state-trie account traversal requires a populated account index"
+    def _get_last_indexed_number(self) -> Optional[bytes]:
+        return self.db.get(address_mapping_head_key)
+
+    def _get_block_receipts(self, block_hash: bytes, num: int) -> List[Receipt]:
+        raw = self.db.get(
+            block_receipts_prefix + _format_block_number(num) + block_hash
         )
+        if raw is None:
+            return []
+        return [Receipt(fields) for fields in rlp.decode(raw)]
+
+
+class LevelDBWriter:
+    """Write access for the address index."""
+
+    def __init__(self, db):
+        self.db = db
+        self.wb = None
+
+    def _set_last_indexed_number(self, number: int) -> None:
+        self.db.put(address_mapping_head_key, _format_block_number(number))
+
+    def _start_writing(self) -> None:
+        self.wb = self.db.write_batch()
+
+    def _commit_batch(self) -> None:
+        self.wb.write()
+
+    def _store_account_address(self, address: bytes) -> None:
+        self.wb.put(address_prefix + keccak256(address), address)
 
 
 class EthLevelDB:
-    """Go-Ethereum chaindata search interface (reference client.py)."""
+    """Go-Ethereum chaindata interface (reference client.py:196)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str = None, db=None):
         self.path = path
-        self.db = EthDB(path)
+        self.db = db if db is not None else EthDB(path)
         self.reader = LevelDBReader(self.db)
+        self.writer = LevelDBWriter(self.db)
 
-    def contract_hash_to_address(self, contract_hash: str) -> str:
-        """keccak(code) hex -> contract address via the account index."""
-        address_hash = binascii.a2b_hex(contract_hash.replace("0x", ""))
-        address = self.reader._get_address_by_hash(address_hash)
-        if address is None:
-            raise AddressNotFoundError
-        return "0x" + address.hex()
+    def get_contracts(self) -> Iterator[Tuple[EVMContract, bytes, int]]:
+        """(contract, address_hash, balance) for every code-bearing
+        account in the head state."""
+        for account in self.reader._get_head_state().get_all_accounts():
+            code = account.code
+            if code is not None:
+                yield EVMContract("0x" + code.hex()), account.address, account.balance
 
-    def search(self, expression: str, callback: Callable[[EVMContract, List[str], List[int]], None]):
-        """Scan all stored code blobs for a regex; callback per match."""
-        import re
+    def search(
+        self, expression: str, callback: Callable[[EVMContract, str, int], None]
+    ) -> None:
+        """Regex search over all contract code; resolves addresses
+        through the account index."""
+        from mythril_tpu.ethereum.interface.leveldb.accountindexing import (
+            AccountIndexer,
+        )
 
-        cnt = 0
         pattern = re.compile(expression)
-        for key, value in self.db.db:  # pragma: no cover - needs real chaindata
-            if len(value) < 2:
-                continue
-            code = "0x" + value.hex()
-            if pattern.search(code):
-                contract = EVMContract(code)
-                code_hash = "0x" + keccak256(value).hex()
-                try:
-                    address = self.contract_hash_to_address(code_hash)
-                except AddressNotFoundError:
-                    address = code_hash
-                callback(contract, [address], [0])
+        indexer = AccountIndexer(self)
+        cnt = 0
+        for contract, address_hash, balance in self.get_contracts():
             cnt += 1
             if cnt % 1000 == 0:
                 log.info("searched %d contracts", cnt)
+            if pattern.search(contract.code):
+                try:
+                    address = "0x" + indexer.get_contract_by_hash(address_hash).hex()
+                except AddressNotFoundError:
+                    # internal-tx creations are absent from the receipt
+                    # index; skip like the reference does
+                    continue
+                callback(contract, address, balance)
+
+    def contract_hash_to_address(self, contract_hash: str) -> str:
+        """keccak(address) hex -> address hex via the account index."""
+        from mythril_tpu.ethereum.interface.leveldb.accountindexing import (
+            AccountIndexer,
+        )
+
+        address_hash = binascii.a2b_hex(contract_hash.replace("0x", ""))
+        indexer = AccountIndexer(self)
+        return "0x" + indexer.get_contract_by_hash(address_hash).hex()
+
+    def eth_getBlockHeaderByNumber(self, number: int) -> BlockHeader:
+        block_hash = self.reader._get_block_hash(number)
+        if block_hash is None:
+            raise CriticalError(f"block {number} not found in chaindata")
+        return self.reader._get_block_header(
+            block_hash, _format_block_number(number)
+        )
+
+    def eth_getBlockByNumber(self, number: int):
+        """Raw decoded block body ([txs, uncles])."""
+        block_hash = self.reader._get_block_hash(number)
+        if block_hash is None:
+            raise CriticalError(f"block {number} not found in chaindata")
+        raw = self.db.get(
+            body_prefix + _format_block_number(number) + block_hash
+        )
+        if raw is None:
+            # fast-sync/pruned stores can hold a header without its body
+            return [[], []]
+        return rlp.decode(raw)
 
     def eth_getCode(self, address: str) -> str:
-        raise NotImplementedError(
-            "direct state reads from LevelDB require trie traversal; use RPC"
-        )
+        code = self.reader._get_account(address).code
+        return "0x" + (code or b"").hex()
+
+    def eth_getBalance(self, address: str) -> int:
+        return self.reader._get_account(address).balance
+
+    def eth_getStorageAt(self, address: str, position: int) -> str:
+        value = self.reader._get_account(address).get_storage_data(position)
+        return "0x" + value.to_bytes(32, "big").hex()

@@ -27,3 +27,46 @@ class EthDB:
 
     def put(self, key: bytes, value: bytes) -> None:
         self.db.put(key, value)
+
+    def write_batch(self):
+        return self.db.write_batch()
+
+    def __iter__(self):
+        return iter(self.db)
+
+
+class MemoryDB:
+    """Dict-backed stand-in with the same surface as EthDB.
+
+    Lets the chaindata reader (state trie walk, account indexing, code
+    search) run against authored fixtures — and without the optional
+    plyvel dependency.
+    """
+
+    def __init__(self, data=None):
+        self.data = dict(data or {})
+
+    def get(self, key: bytes):
+        return self.data.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.data[key] = value
+
+    def write_batch(self):
+        return _MemoryBatch(self)
+
+    def __iter__(self):
+        return iter(self.data.items())
+
+
+class _MemoryBatch:
+    def __init__(self, db: MemoryDB):
+        self.db = db
+        self.pending = {}
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.pending[key] = value
+
+    def write(self) -> None:
+        self.db.data.update(self.pending)
+        self.pending = {}

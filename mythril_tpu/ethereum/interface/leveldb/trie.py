@@ -1,0 +1,173 @@
+"""Hexary Merkle-Patricia trie: reader (walk geth's state/storage
+tries) and builder (construct static tries for snapshots and tests).
+
+The reference reads the state trie through pyethereum's
+``trie.Trie``/``securetrie.SecureTrie`` (mythril/ethereum/interface/
+leveldb/state.py); this is a dependency-free equivalent against any
+``get(node_hash) -> rlp_bytes`` backend.
+
+Node forms (yellow-paper appendix D):
+- branch: 17-item list — one child ref per nibble + value slot
+- leaf/extension: 2-item list — hex-prefix-encoded path + (value | ref)
+- a child ref is a 32-byte keccak of the child's RLP if that RLP is
+  >= 32 bytes, otherwise the child node is embedded in place
+"""
+
+from typing import Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+from mythril_tpu.ethereum import rlp
+from mythril_tpu.support.keccak import keccak256
+
+BLANK_ROOT = keccak256(rlp.encode(b""))  # root of the empty trie
+
+Node = Union[bytes, List]
+
+
+def nibbles_of(key: bytes) -> List[int]:
+    out = []
+    for byte in key:
+        out.append(byte >> 4)
+        out.append(byte & 0x0F)
+    return out
+
+
+def encode_hex_prefix(nibbles: List[int], is_leaf: bool) -> bytes:
+    """Compact (hex-prefix) encoding of a nibble path."""
+    flag = 2 if is_leaf else 0
+    if len(nibbles) % 2:
+        prefixed = [flag + 1] + nibbles
+    else:
+        prefixed = [flag, 0] + nibbles
+    return bytes(
+        (prefixed[i] << 4) | prefixed[i + 1] for i in range(0, len(prefixed), 2)
+    )
+
+
+def decode_hex_prefix(b: bytes) -> Tuple[List[int], bool]:
+    nib = nibbles_of(b)
+    is_leaf = nib[0] >= 2
+    skip = 1 if nib[0] % 2 else 2
+    return nib[skip:], is_leaf
+
+
+class TrieReader:
+    """Read-only trie walk over a node backend."""
+
+    def __init__(self, get_node: Callable[[bytes], Optional[bytes]], root: bytes):
+        self.get_node = get_node
+        self.root = root
+
+    def _resolve(self, ref: Node) -> Optional[List]:
+        """Child ref -> decoded node list (or None for an empty slot)."""
+        if isinstance(ref, list):
+            return ref if ref else None
+        if ref == b"":
+            return None
+        raw = self.get_node(ref)
+        if raw is None:
+            return None
+        node = rlp.decode(raw)
+        return node if isinstance(node, list) else None
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        """Value stored at ``key``, or None."""
+        if self.root == BLANK_ROOT or not self.root:
+            return None
+        path = nibbles_of(key)
+        node = self._resolve(self.root)
+        while node is not None:
+            if len(node) == 17:
+                if not path:
+                    return node[16] or None
+                node, path = self._resolve(node[path[0]]), path[1:]
+                continue
+            frag, is_leaf = decode_hex_prefix(node[0])
+            if is_leaf:
+                return node[1] if frag == path else None
+            if path[: len(frag)] != frag:
+                return None
+            node, path = self._resolve(node[1]), path[len(frag) :]
+        return None
+
+    def items(self) -> Iterator[Tuple[bytes, bytes]]:
+        """All (key, value) pairs; keys are reassembled from the paths
+        (for a secure trie these are the keccak'd keys)."""
+        if self.root == BLANK_ROOT or not self.root:
+            return
+        yield from self._walk(self._resolve(self.root), [])
+
+    def _walk(self, node, prefix):
+        if node is None:
+            return
+        if len(node) == 17:
+            if node[16]:
+                yield _nibbles_to_bytes(prefix), node[16]
+            for i in range(16):
+                child = self._resolve(node[i])
+                if child is not None:
+                    yield from self._walk(child, prefix + [i])
+            return
+        frag, is_leaf = decode_hex_prefix(node[0])
+        if is_leaf:
+            yield _nibbles_to_bytes(prefix + frag), node[1]
+        else:
+            yield from self._walk(self._resolve(node[1]), prefix + frag)
+
+
+def _nibbles_to_bytes(nib: List[int]) -> bytes:
+    return bytes((nib[i] << 4) | nib[i + 1] for i in range(0, len(nib), 2))
+
+
+def build_trie(items: Dict[bytes, bytes]) -> Tuple[bytes, Dict[bytes, bytes]]:
+    """Construct a static trie; returns (root_hash, node_store).
+
+    The store maps keccak(node_rlp) -> node_rlp for every node whose
+    encoding is >= 32 bytes (smaller nodes are embedded per the spec).
+    Used to author chaindata fixtures and state snapshots.
+    """
+    store: Dict[bytes, bytes] = {}
+
+    def ref_of(node) -> Node:
+        """Node structure -> child ref (hash or embedded)."""
+        encoded = rlp.encode(node)
+        if len(encoded) < 32:
+            return node
+        h = keccak256(encoded)
+        store[h] = encoded
+        return h
+
+    def build(pairs: List[Tuple[List[int], bytes]]):
+        """Nibble-path pairs -> node structure (not yet ref'd)."""
+        if not pairs:
+            return b""
+        if len(pairs) == 1:
+            path, value = pairs[0]
+            return [encode_hex_prefix(path, True), value]
+        # longest common prefix
+        first = pairs[0][0]
+        lcp = 0
+        while all(
+            len(p) > lcp and p[lcp] == first[lcp] for p, _ in pairs
+        ) and lcp < len(first):
+            lcp += 1
+        if lcp:
+            child = build([(p[lcp:], v) for p, v in pairs])
+            return [encode_hex_prefix(first[:lcp], False), ref_of(child)]
+        branch: List[Node] = [b""] * 17
+        for nib in range(16):
+            sub = [(p[1:], v) for p, v in pairs if p and p[0] == nib]
+            if sub:
+                branch[nib] = ref_of(build(sub))
+        term = [v for p, v in pairs if not p]
+        if term:
+            branch[16] = term[0]
+        return branch
+
+    pairs = sorted((nibbles_of(k), v) for k, v in items.items())
+    root_node = build(pairs)
+    if root_node == b"":
+        return BLANK_ROOT, {BLANK_ROOT: rlp.encode(b"")}
+    encoded = rlp.encode(root_node)
+    root = keccak256(encoded)
+    store[root] = encoded
+    return root, store

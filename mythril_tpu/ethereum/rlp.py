@@ -1,0 +1,78 @@
+"""Minimal RLP codec (encode + decode), dependency-free.
+
+The reference leans on the external ``rlp``/pyethereum packages for its
+LevelDB layer (mythril/ethereum/interface/leveldb/client.py,
+state.py); this framework inlines the ~60 lines instead. Decoded form
+is nested lists of ``bytes``; the encoder accepts ``bytes``, ``int``
+(big-endian minimal), and (nested) lists thereof.
+"""
+
+from typing import List, Union
+
+RLPItem = Union[bytes, int, List["RLPItem"]]
+
+
+def encode(obj: RLPItem) -> bytes:
+    if isinstance(obj, int):
+        obj = int_to_bytes(obj)
+    if isinstance(obj, (bytes, bytearray)):
+        b = bytes(obj)
+        if len(b) == 1 and b[0] < 0x80:
+            return b
+        return _length_prefix(len(b), 0x80) + b
+    if isinstance(obj, (list, tuple)):
+        payload = b"".join(encode(x) for x in obj)
+        return _length_prefix(len(payload), 0xC0) + payload
+    raise TypeError(f"cannot RLP-encode {type(obj)}")
+
+
+def _length_prefix(n: int, offset: int) -> bytes:
+    if n < 56:
+        return bytes([offset + n])
+    nb = int_to_bytes(n)
+    return bytes([offset + 55 + len(nb)]) + nb
+
+
+def int_to_bytes(x: int) -> bytes:
+    """Minimal big-endian encoding; 0 encodes as the empty string."""
+    if x == 0:
+        return b""
+    return x.to_bytes((x.bit_length() + 7) // 8, "big")
+
+
+def bytes_to_int(b: bytes) -> int:
+    return int.from_bytes(b, "big") if b else 0
+
+
+def decode(data: bytes):
+    """bytes -> nested lists of bytes (one top-level item)."""
+    item, end = decode_at(data, 0)
+    return item
+
+
+def decode_at(data: bytes, idx: int):
+    """Decode one item at ``idx``; returns (item, next_index)."""
+    prefix = data[idx]
+    if prefix < 0x80:
+        return bytes([prefix]), idx + 1
+    if prefix < 0xB8:
+        n = prefix - 0x80
+        return data[idx + 1 : idx + 1 + n], idx + 1 + n
+    if prefix < 0xC0:
+        lenlen = prefix - 0xB7
+        n = int.from_bytes(data[idx + 1 : idx + 1 + lenlen], "big")
+        start = idx + 1 + lenlen
+        return data[start : start + n], start + n
+    if prefix < 0xF8:
+        n = prefix - 0xC0
+    else:
+        lenlen = prefix - 0xF7
+        n = int.from_bytes(data[idx + 1 : idx + 1 + lenlen], "big")
+        idx += lenlen
+    end = idx + 1 + n
+    items = []
+    i = idx + 1
+    while i < end:
+        item, i = decode_at(data, i)
+        items.append(item)
+    return items, end

@@ -1,0 +1,87 @@
+"""Detection sweep over the reference corpus bytecode.
+
+Replays the precompiled contracts from the upstream test corpus
+(/root/reference/tests/testdata/inputs/*.sol.o — runtime bytecode, no
+solc needed) through the full analysis pipeline and asserts the SWC
+findings per contract, mirroring the expectations encoded in the
+upstream report/statespace tests (reference tests/report_test.py,
+tests/cmd_line_test.py).
+
+Two layers:
+- a host-strategy sweep over every corpus file (the slowest two are
+  gated behind MYTHRIL_TPU_CORPUS=full so the default run stays fast);
+- a host/device parity check on a subset through ``tpu-batch``, which
+  asserts the device-assisted pipeline reports the same SWC set.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.ethereum.evmcontract import EVMContract
+
+CORPUS = Path("/root/reference/tests/testdata/inputs")
+FULL = os.environ.get("MYTHRIL_TPU_CORPUS") == "full"
+
+pytestmark = pytest.mark.skipif(
+    not CORPUS.is_dir(), reason="reference corpus not mounted"
+)
+
+# file -> (SWC ids that must be reported, SWC ids that must NOT be)
+EXPECTED = {
+    "calls.sol.o": ({"104", "107"}, {"106"}),
+    "environments.sol.o": ({"101"}, {"106"}),
+    "ether_send.sol.o": ({"105"}, {"106"}),
+    "exceptions.sol.o": ({"110"}, {"106"}),
+    "kinds_of_calls.sol.o": ({"104", "107", "112"}, {"106"}),
+    "metacoin.sol.o": (set(), {"105", "106"}),
+    "multi_contracts.sol.o": ({"105"}, {"106"}),
+    "nonascii.sol.o": (set(), {"101", "105", "106"}),
+    "origin.sol.o": ({"115"}, {"106"}),
+    "overflow.sol.o": ({"101"}, {"106"}),
+    "returnvalue.sol.o": ({"104"}, {"106"}),
+    "suicide.sol.o": ({"106"}, set()),
+    "underflow.sol.o": ({"101"}, {"106"}),
+}
+
+# wall-heavy under the in-repo solver; default run keeps its budget for
+# the rest of the sweep
+SLOW = {"calls.sol.o", "environments.sol.o"}
+
+
+def analyze(name: str, strategy: str = "bfs", timeout: int = 150):
+    code = (CORPUS / name).read_text().strip()
+    contract = EVMContract(code=code, name=name)
+    sym = SymExecWrapper(
+        contract,
+        address=0x1234,
+        strategy=strategy,
+        execution_timeout=timeout,
+        transaction_count=2,
+        max_depth=128,
+    )
+    issues = fire_lasers(sym)
+    swcs = set()
+    for issue in issues:
+        swcs.update(issue.swc_id.split())
+    return swcs
+
+
+@pytest.mark.parametrize(
+    "name", sorted(f for f in EXPECTED if FULL or f not in SLOW)
+)
+def test_corpus_host(name):
+    must, must_not = EXPECTED[name]
+    swcs = analyze(name)
+    assert must <= swcs, f"{name}: missing {must - swcs} (got {swcs})"
+    assert not (must_not & swcs), f"{name}: spurious {must_not & swcs}"
+
+
+@pytest.mark.parametrize("name", ["origin.sol.o", "suicide.sol.o"])
+def test_corpus_device_parity(name):
+    host = analyze(name)
+    device = analyze(name, strategy="tpu-batch", timeout=300)
+    assert host == device, f"{name}: host {host} != device {device}"

@@ -1,0 +1,150 @@
+"""Chaindata reader tests over an authored in-memory geth database.
+
+Builds a real state trie (via the in-repo MPT builder), storage trie,
+code blobs, block headers and creation receipts, then exercises every
+read path of the LevelDB layer: eth_getCode / eth_getBalance /
+eth_getStorageAt, header/body lookups, account indexing
+(hash -> address), and regex code search. Parity:
+mythril/ethereum/interface/leveldb/client.py + state.py behavior.
+"""
+
+import pytest
+
+from mythril_tpu.ethereum import rlp
+from mythril_tpu.ethereum.interface.leveldb import client as lvl
+from mythril_tpu.ethereum.interface.leveldb.eth_db import MemoryDB
+from mythril_tpu.ethereum.interface.leveldb.trie import (
+    TrieReader,
+    build_trie,
+)
+from mythril_tpu.exceptions import AddressNotFoundError
+from mythril_tpu.support.keccak import keccak256
+
+CONTRACT_ADDR = bytes.fromhex("c0de000000000000000000000000000000000001")
+EOA_ADDR = bytes.fromhex("ab1e000000000000000000000000000000000002")
+CODE = bytes.fromhex("6001600101")  # PUSH1 1 PUSH1 1 ADD
+
+
+def _header_rlp(parent: bytes, state_root: bytes, number: int) -> bytes:
+    fields = [
+        parent,  # parent hash
+        b"\x00" * 32,  # uncles
+        b"\x00" * 20,  # coinbase
+        state_root,
+        b"\x00" * 32,  # tx root
+        b"\x00" * 32,  # receipt root
+        b"\x00" * 256,  # bloom
+        1,  # difficulty
+        number,
+        8_000_000,  # gas limit
+        0,  # gas used
+        1_700_000_000,  # timestamp
+        b"",  # extra
+        b"\x00" * 32,  # mixhash
+        b"\x00" * 8,  # nonce
+    ]
+    return rlp.encode(fields)
+
+
+@pytest.fixture()
+def chaindata():
+    db = MemoryDB()
+
+    # contract storage: slot 3 = 0x2a
+    storage_root, storage_nodes = build_trie(
+        {keccak256((3).to_bytes(32, "big")): rlp.encode(0x2A)}
+    )
+    for h, raw in storage_nodes.items():
+        db.put(h, raw)
+    db.put(keccak256(CODE), CODE)
+
+    contract_account = rlp.encode([1, 1000, storage_root, keccak256(CODE)])
+    eoa_account = rlp.encode([5, 7_777, lvl.BLANK_ROOT, lvl.BLANK_CODE_HASH])
+    state_root, state_nodes = build_trie(
+        {
+            keccak256(CONTRACT_ADDR): contract_account,
+            keccak256(EOA_ADDR): eoa_account,
+        }
+    )
+    for h, raw in state_nodes.items():
+        db.put(h, raw)
+
+    # chain: genesis (0) -> head (1); head carries the state root
+    genesis = _header_rlp(b"", state_root, 0)
+    genesis_hash = keccak256(genesis)
+    head = _header_rlp(genesis_hash, state_root, 1)
+    head_hash = keccak256(head)
+    for num, (raw, block_hash) in enumerate(
+        [(genesis, genesis_hash), (head, head_hash)]
+    ):
+        num8 = num.to_bytes(8, "big")
+        db.put(lvl.header_prefix + num8 + block_hash, raw)
+        db.put(lvl.header_prefix + num8 + lvl.num_suffix, block_hash)
+        db.put(lvl.block_hash_prefix + block_hash, num8)
+    db.put(lvl.head_header_key, head_hash)
+
+    # block 1 receipt: creation of CONTRACT_ADDR
+    receipt = [b"\x01", 21_000, b"\x00" * 256, b"\x11" * 32, CONTRACT_ADDR, [], 21_000]
+    db.put(
+        lvl.block_receipts_prefix + (1).to_bytes(8, "big") + head_hash,
+        rlp.encode([receipt]),
+    )
+    # empty body for the header-by-number/body path
+    db.put(lvl.body_prefix + (1).to_bytes(8, "big") + head_hash, rlp.encode([[], []]))
+
+    return lvl.EthLevelDB(db=db)
+
+
+def test_trie_roundtrip():
+    items = {bytes([i, i ^ 0x5A, 7]): bytes([i]) * 3 for i in range(40)}
+    root, nodes = build_trie(items)
+    reader = TrieReader(nodes.get, root)
+    for k, v in items.items():
+        assert reader.get(k) == v
+    assert reader.get(b"\xff\xff\xff") is None
+    assert dict(reader.items()) == items
+
+
+def test_eth_get_code(chaindata):
+    assert chaindata.eth_getCode("0x" + CONTRACT_ADDR.hex()) == "0x" + CODE.hex()
+    assert chaindata.eth_getCode("0x" + EOA_ADDR.hex()) == "0x"
+
+
+def test_eth_get_balance_and_storage(chaindata):
+    assert chaindata.eth_getBalance("0x" + CONTRACT_ADDR.hex()) == 1000
+    assert chaindata.eth_getBalance("0x" + EOA_ADDR.hex()) == 7_777
+    # unknown account reads as blank, not an error
+    assert chaindata.eth_getBalance("0x" + "00" * 20) == 0
+    slot3 = chaindata.eth_getStorageAt("0x" + CONTRACT_ADDR.hex(), 3)
+    assert int(slot3, 16) == 0x2A
+    assert int(chaindata.eth_getStorageAt("0x" + CONTRACT_ADDR.hex(), 9), 16) == 0
+
+
+def test_block_lookups(chaindata):
+    header = chaindata.eth_getBlockHeaderByNumber(1)
+    assert header.number == 1
+    body = chaindata.eth_getBlockByNumber(1)
+    assert body == [[], []]
+
+
+def test_hash_to_address_via_index(chaindata):
+    found = chaindata.contract_hash_to_address(
+        "0x" + keccak256(CONTRACT_ADDR).hex()
+    )
+    assert found == "0x" + CONTRACT_ADDR.hex()
+    with pytest.raises(AddressNotFoundError):
+        chaindata.contract_hash_to_address("0x" + "ee" * 32)
+
+
+def test_search_resolves_addresses(chaindata):
+    hits = []
+    chaindata.search("6001600101", lambda c, addr, bal: hits.append((addr, bal)))
+    assert hits == [("0x" + CONTRACT_ADDR.hex(), 1000)]
+
+
+def test_get_contracts_yields_code_accounts(chaindata):
+    contracts = list(chaindata.get_contracts())
+    assert len(contracts) == 1
+    _, address_hash, balance = contracts[0]
+    assert address_hash == keccak256(CONTRACT_ADDR)
+    assert balance == 1000
