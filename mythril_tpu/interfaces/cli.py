@@ -9,6 +9,7 @@ composed per command, and all output formatting funnels through one
 Subcommands:
   analyze (a)         symbolic-execution security analysis
   disassemble (d)     bytecode -> assembly listing
+  pro                 remote analysis through the MythX API
   list-detectors      registered detection modules
   version             package version
   function-to-hash    4-byte selector for a signature
@@ -207,6 +208,21 @@ def emit_report(report, outform: str) -> None:
     print(renderers[outform]())
 
 
+def run_pro(args) -> None:
+    """Remote analysis through the MythX API (reference cli.py:229)."""
+    from mythril_tpu import mythx
+    from mythril_tpu.analysis.report import Report
+
+    config = _make_config(args)
+    disassembler = _make_disassembler(args, config)
+    _load_code(args, disassembler)
+    issues = mythx.analyze(disassembler.contracts, args.mode)
+    report = Report(contracts=disassembler.contracts)
+    for issue in issues:
+        report.append_issue(issue)
+    emit_report(report, args.outform)
+
+
 def run_disassemble(args) -> None:
     config = _make_config(args)
     disassembler = _make_disassembler(args, config)
@@ -282,6 +298,21 @@ COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
         [add_input_flags, add_rpc_flags, add_output_flag],
         run_disassemble,
     ),
+    "pro": (
+        "Analyzes input with the MythX API (https://mythx.io)",
+        [
+            add_input_flags,
+            add_rpc_flags,
+            add_output_flag,
+            lambda p: p.add_argument(
+                "--mode",
+                choices=("quick", "standard", "deep"),
+                default="quick",
+                help="MythX analysis mode",
+            ),
+        ],
+        run_pro,
+    ),
     "list-detectors": (
         "Lists the available detection modules",
         [add_output_flag],
@@ -340,6 +371,20 @@ def _set_verbosity(level: int) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    # persistent XLA compile cache: the device kernels take tens of
+    # seconds to compile; repeat CLI invocations should pay that once.
+    # The env var only reaches jax if it is imported later; when a
+    # sitecustomize already imported jax at interpreter start, the config
+    # must be updated directly.
+    cache_dir = os.path.join(
+        os.path.expanduser("~"), ".cache", "mythril_tpu", "jax"
+    )
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    if "jax" in sys.modules:
+        sys.modules["jax"].config.update(
+            "jax_compilation_cache_dir",
+            os.environ["JAX_COMPILATION_CACHE_DIR"],
+        )
     parser = build_parser()
     args = parser.parse_args(argv)
     command = ALIASES.get(args.command, args.command)

@@ -146,8 +146,8 @@ class DeviceBridge:
         return lane
 
     def finish(self) -> Tuple[CodeBank, StateBatch]:
-        """Freeze the staged lanes into device arrays."""
-        import jax.numpy as jnp
+        """Freeze the staged lanes into device arrays (one upload)."""
+        from mythril_tpu.laser.tpu import transfer
 
         if self._np_batch is None or self._n_staged == 0:
             raise PackError("nothing staged")
@@ -157,7 +157,7 @@ class DeviceBridge:
             host_ops=self.host_ops,
             freeze_errors=self.freeze_errors,
         )
-        st = StateBatch(**{k: jnp.asarray(v) for k, v in self._np_batch.items()})
+        st = transfer.batch_to_device(self._np_batch, self.cfg)
         return cb, st
 
     def pack(self, states: List[GlobalState]) -> Tuple[CodeBank, StateBatch]:

@@ -321,6 +321,7 @@ def _get_kernel():
 _seed_counter = [0]
 
 
+
 def check_batch(
     constraint_sets: Sequence[Sequence[Term]],
     flips: Optional[int] = None,
@@ -359,9 +360,14 @@ def check_batch(
         lits, nvars, is_input, V = _pack_batch(chunk, MAX_VARS, MAX_CLAUSES)
         _seed_counter[0] += 1
         key = jax.random.PRNGKey(_seed_counter[0])
-        status, _assign = kernel(
-            jnp.asarray(lits), key, jnp.asarray(nvars), jnp.asarray(is_input), V, flips
+        # one upload: the three operand arrays ride a single buffer (the
+        # tunnel's per-transfer latency dwarfs the bytes)
+        from mythril_tpu.laser.tpu import transfer
+
+        d_lits, d_nvars, d_input = transfer.upload_segments(
+            [lits, nvars, is_input]
         )
+        status, _assign = kernel(d_lits, key, d_nvars, d_input, V, flips)
         status = np.asarray(status)
         for k in range(len(chunk)):
             results[live_idx[lo + k]] = int(status[k])

@@ -1,0 +1,273 @@
+"""Single-buffer host<->device movement of a StateBatch.
+
+The hybrid loop (backend.exec_batch) repacks a batch every round. Moving
+the ~50 planes individually costs one transport round trip each — on a
+tunneled TPU that latency (~100 ms/transfer) dwarfs the device compute
+and throttled the integrated pipeline to ~1 state/s. Both directions now
+serialize the whole batch into ONE u8 buffer:
+
+- up: the host concatenates every plane's raw bytes (numpy, zero-copy
+  views), uploads once, and a jitted splitter bitcasts the segments back
+  into planes on device;
+- down: a jitted flattener concatenates bitcast planes on device, the
+  host downloads once and rebuilds a StateBatch of numpy views.
+
+Byte layout is the NamedTuple field order; bitcasts are little-endian on
+both sides (numpy ``view`` on the host, ``lax.bitcast_convert_type`` on
+TPU/CPU XLA), which the round-trip test pins down.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mythril_tpu.laser.tpu.batch import StateBatch, batch_shapes
+
+# planes the host-side consumers (bridge lift/unpack, coverage merge,
+# checkpointing) never read — skipped on the way down to save bytes;
+# they are rebuilt as zeros in the host view (a downloaded batch is
+# never re-uploaded: every round packs fresh from host states)
+_SKIP_DOWN = ("tape_h1", "tape_h2")
+
+
+# row-sliceable planes: (axis-1 capacity field in BatchConfig is implied
+# by the plane's static shape; slicing drops all-zero tail rows). The
+# term-tape planes dominate batch bytes, so only they are bucketed —
+# everything else ships full-size, keeping the jit-variant count small.
+_TAPE_PLANES = ("tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1", "tape_h2")
+_TAPE_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
+def _bucket(n: int, cap: int) -> int:
+    for b in _TAPE_BUCKETS:
+        if n <= b and b <= cap:
+            return b
+    return cap
+
+
+# skippable plane groups for the upload. Presence is tracked per GROUP
+# (one bit each), not per plane: the presence tuple is part of the
+# splitter's static jit key, so per-plane granularity would let the
+# compile-variant count grow combinatorially with whatever mix of
+# states each round stages. Three bits x tape buckets stays bounded.
+_UP_GROUPS = {
+    "symbolic": (
+        "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1",
+        "tape_h2", "tape_len", "path_id", "path_sign", "path_len",
+        "msym_off", "msym_id", "msym_used", "skey_sym", "sval_sym",
+        "calldata_symbolic", "storage_symbolic", "cdsize_sym",
+        "caller_sym", "callvalue_sym", "origin_sym", "balance_sym",
+    ),
+    "memory": ("memory", "mem_words"),
+    "storage": ("storage_key", "storage_val", "storage_used"),
+}
+_GROUP_OF = {
+    plane: group for group, planes in _UP_GROUPS.items() for plane in planes
+}
+
+
+def serialize_segments(arrays) -> np.ndarray:
+    """Host side: raw little-endian bytes of ``arrays``, concatenated."""
+    if not arrays:
+        return np.zeros(0, np.uint8)
+    return np.concatenate(
+        [np.ascontiguousarray(a).view(np.uint8).ravel() for a in arrays]
+    )
+
+
+def split_segments(buf, spec):
+    """Device side of :func:`serialize_segments`: walk the buffer and
+    rebuild each ``(shape, dtype_str)`` segment (bools via ``!= 0``).
+    Runs under jit with ``spec`` static."""
+    out = []
+    off = 0
+    for shape, dtype_str in spec:
+        dtype = np.dtype(dtype_str)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        seg = jax.lax.dynamic_slice(buf, (off,), (nbytes,))
+        off += nbytes
+        if dtype == np.bool_:
+            out.append(seg.reshape(shape) != 0)
+        elif dtype.itemsize == 1:
+            out.append(seg.reshape(shape).view(jnp.dtype(dtype)))
+        else:
+            out.append(
+                jax.lax.bitcast_convert_type(
+                    seg.reshape(tuple(shape) + (dtype.itemsize,)),
+                    jnp.dtype(dtype),
+                )
+            )
+    return out
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _split_jit(buf, spec):
+    return tuple(split_segments(buf, spec))
+
+
+def upload_segments(arrays):
+    """One-buffer upload of arbitrary host arrays; returns the device
+    arrays. The segment spec is derived from the inputs."""
+    spec = tuple(
+        (tuple(a.shape), np.dtype(a.dtype).str) for a in arrays
+    )
+    return _split_jit(jnp.asarray(serialize_segments(arrays)), spec)
+
+
+def batch_to_device(np_batch: dict, cfg) -> StateBatch:
+    """Host plane dict -> device StateBatch via one upload.
+
+    Plane groups with no content (no symbolic layer, no memory writes,
+    no storage) are skipped and rebuilt as zeros on device, and the
+    term-tape planes upload only their used row prefix — a freshly
+    packed batch is mostly zeros, so the wire payload is typically a few
+    hundred KB instead of the full batch.
+    """
+    shapes = batch_shapes(cfg)
+    t_used = _bucket(int(np_batch["tape_len"].max()), cfg.tape_slots)
+    absent = tuple(
+        sorted(
+            group
+            for group, planes in _UP_GROUPS.items()
+            if not any(np_batch[p].any() for p in planes)
+        )
+    )
+    segments = []
+    for name in shapes:
+        if _GROUP_OF.get(name) in absent:
+            continue
+        arr = np_batch[name]
+        if name in _TAPE_PLANES:
+            arr = arr[:, :t_used]
+        segments.append(arr)
+    full_key = tuple(
+        (name, tuple(shape), np.dtype(dtype).str)
+        for name, (shape, dtype) in shapes.items()
+    )
+    buf = serialize_segments(segments)
+    planes = _split_batch(jnp.asarray(buf), full_key, absent, t_used)
+    return StateBatch(**dict(zip(shapes.keys(), planes)))
+
+
+@partial(jax.jit, static_argnames=("full_key", "absent", "t_used"))
+def _split_batch(buf, full_key, absent, t_used):
+    spec = []
+    shipped = []
+    for name, full_shape, dtype_str in full_key:
+        if _GROUP_OF.get(name) in absent:
+            continue
+        shape = full_shape
+        if name in _TAPE_PLANES:
+            shape = (shape[0], t_used) + tuple(shape[2:])
+        spec.append((shape, dtype_str))
+        shipped.append(name)
+    parts = dict(zip(shipped, split_segments(buf, tuple(spec))))
+    out = []
+    for name, full_shape, dtype_str in full_key:
+        arr = parts.get(name)
+        if arr is None:
+            dtype = np.dtype(dtype_str)
+            zero_dtype = jnp.bool_ if dtype == np.bool_ else jnp.dtype(dtype)
+            out.append(jnp.zeros(full_shape, zero_dtype))
+            continue
+        if tuple(arr.shape) != tuple(full_shape):
+            pad = [(0, f - s) for f, s in zip(full_shape, arr.shape)]
+            arr = jnp.pad(arr, pad)
+        out.append(arr)
+    return out
+
+
+# bulky planes deferred to the second (sized) fetch; everything else is
+# small [L]/[L,k] bookkeeping that rides in the first fetch, which also
+# carries tape_len so the host can size the tape slice statically
+_BIG_DOWN = (
+    "stack",
+    "stack_sym",
+    "memory",
+    "visited",
+    "calldata",
+    "storage_key",
+    "storage_val",
+    "tape_op",
+    "tape_a",
+    "tape_b",
+    "tape_imm",
+)
+
+
+def _unpack_host(buf: np.ndarray, shapes) -> dict:
+    planes = {}
+    off = 0
+    for name, shape, dtype in shapes:
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        planes[name] = buf[off : off + nbytes].view(dtype).reshape(shape)
+        off += nbytes
+    return planes
+
+
+def batch_to_host(st: StateBatch) -> StateBatch:
+    """Device StateBatch -> StateBatch of numpy planes in two downloads.
+
+    Fetch 1 moves the small bookkeeping planes (including ``tape_len``);
+    fetch 2 moves the bulky planes with the term-tape rows sliced to the
+    observed maximum, so a mostly-concrete round moves ~1 MB instead of
+    the full batch. ``np.asarray`` on the result's fields is free, so
+    everything downstream of a device round (lift/unpack, coverage, step
+    counters) reads this view without further transfers.
+    """
+    small = tuple(
+        f
+        for f in StateBatch._fields
+        if f not in _SKIP_DOWN and f not in _BIG_DOWN
+    )
+    small_shapes = [
+        (f, tuple(getattr(st, f).shape), np.dtype(getattr(st, f).dtype))
+        for f in small
+    ]
+    planes = _unpack_host(np.asarray(_flatten_device(st, small)), small_shapes)
+
+    cap = int(st.tape_op.shape[1])
+    t_used = _bucket(int(planes["tape_len"].max()), cap)
+    big_shapes = []
+    for f in _BIG_DOWN:
+        dev = getattr(st, f)
+        shape = tuple(dev.shape)
+        if f in _TAPE_PLANES:
+            shape = (shape[0], t_used) + shape[2:]
+        big_shapes.append((f, shape, np.dtype(dev.dtype)))
+    planes.update(
+        _unpack_host(
+            np.asarray(_flatten_device(st, _BIG_DOWN, t_used)), big_shapes
+        )
+    )
+    # pad sliced tape planes back to capacity (rows at or past tape_len
+    # are dead by invariant, so zeros are equivalent)
+    for f in _TAPE_PLANES:
+        if f in planes and planes[f].shape[1] != cap:
+            full = np.zeros(
+                (planes[f].shape[0], cap) + planes[f].shape[2:],
+                planes[f].dtype,
+            )
+            full[:, : planes[f].shape[1]] = planes[f]
+            planes[f] = full
+    for name in _SKIP_DOWN:
+        dev = getattr(st, name)
+        planes[name] = np.zeros(dev.shape, dev.dtype)
+    return StateBatch(**planes)
+
+
+@partial(jax.jit, static_argnames=("fields", "t_used"))
+def _flatten_device(st: StateBatch, fields, t_used=None):
+    parts = []
+    for name in fields:
+        x = getattr(st, name)
+        if t_used is not None and name in _TAPE_PLANES:
+            x = x[:, :t_used]
+        if x.dtype == jnp.bool_:
+            x = x.astype(jnp.uint8)
+        if x.dtype.itemsize > 1:
+            x = jax.lax.bitcast_convert_type(x, jnp.uint8)
+        parts.append(x.reshape(-1))
+    return jnp.concatenate(parts)

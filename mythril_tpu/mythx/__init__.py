@@ -1,67 +1,169 @@
 """MythX SaaS client for the `pro` command.
 
-Parity: mythril/mythx/__init__.py:22 — submits sources/bytecode to the
-MythX remote analysis API and maps responses back to `Issue`s. The
-transport dependency (`pythx`) is optional; without it (or without
-network egress) the command fails with a clear message instead of at
-import time.
+Parity: mythril/mythx/__init__.py:22 — submits bytecode to the MythX
+remote analysis API and maps responses back to `Issue`s. Unlike the
+reference (which depends on the external ``pythx`` package), the API
+protocol (JWT login, analysis submission, status polling, issue
+reports) is implemented directly over the standard library, with an
+injectable transport so it is testable without network egress.
 """
 
+import json
 import logging
 import os
-from typing import List
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional
 
 from mythril_tpu.analysis.report import Issue
 from mythril_tpu.exceptions import CriticalError
 
 log = logging.getLogger(__name__)
 
+API_BASE = os.environ.get("MYTHX_API_URL", "https://api.mythx.io/v1")
+TRIAL_ETH_ADDRESS = "0x0000000000000000000000000000000000000000"
+TRIAL_PASSWORD = "trial"
+POLL_INTERVAL_S = 3
+POLL_BUDGET_S = 300
 
-def analyze(contracts, analysis_mode: str = "quick") -> List[Issue]:
-    """Submit contracts to MythX and return mapped issues."""
+
+def _default_transport(
+    method: str, url: str, body: Optional[dict], headers: dict
+) -> dict:
+    """urllib transport: JSON in, JSON out; HTTP errors -> CriticalError."""
+    data = json.dumps(body).encode() if body is not None else None
+    request = urllib.request.Request(
+        url,
+        data=data,
+        method=method,
+        headers={"Content-Type": "application/json", **headers},
+    )
     try:
-        import pythx  # type: ignore
-    except ImportError:
-        raise CriticalError(
-            "The 'pro' command requires the optional 'pythx' package and "
-            "network access to the MythX API; neither is available in this "
-            "environment."
+        with urllib.request.urlopen(request, timeout=30) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.URLError as e:
+        raise CriticalError(f"MythX API unreachable ({url}): {e}") from e
+
+
+class MythXClient:
+    """Minimal MythX API v1 client (login / analyze / poll / issues)."""
+
+    def __init__(
+        self,
+        eth_address: Optional[str] = None,
+        password: Optional[str] = None,
+        transport: Callable = _default_transport,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.eth_address = eth_address or os.environ.get("MYTHX_ETH_ADDRESS")
+        self.password = password or os.environ.get("MYTHX_PASSWORD")
+        if not (self.eth_address and self.password):
+            self.eth_address = TRIAL_ETH_ADDRESS
+            self.password = TRIAL_PASSWORD
+            log.info("No MythX credentials set; using trial mode")
+        self.transport = transport
+        self.sleep = sleep
+        self._token: Optional[str] = None
+
+    def _auth_headers(self) -> dict:
+        if self._token is None:
+            resp = self.transport(
+                "POST",
+                f"{API_BASE}/auth/login",
+                {"ethAddress": self.eth_address, "password": self.password},
+                {},
+            )
+            self._token = resp.get("jwt", {}).get("access") or resp.get(
+                "access"
+            )
+            if not self._token:
+                raise CriticalError("MythX login returned no access token")
+        return {"Authorization": f"Bearer {self._token}"}
+
+    def submit(self, creation_bytecode: str, analysis_mode: str) -> str:
+        resp = self.transport(
+            "POST",
+            f"{API_BASE}/analyses",
+            {
+                "clientToolName": "mythril-tpu",
+                "analysisMode": analysis_mode,
+                "data": {"bytecode": creation_bytecode},
+            },
+            self._auth_headers(),
         )
+        uuid = resp.get("uuid")
+        if not uuid:
+            raise CriticalError(f"MythX submission failed: {resp}")
+        return uuid
 
-    eth_address = os.environ.get("MYTHX_ETH_ADDRESS")
-    password = os.environ.get("MYTHX_PASSWORD")
-    if not (eth_address and password):
-        eth_address = "0x0000000000000000000000000000000000000000"
-        password = "trial"
-        log.info("No MythX credentials set; using trial mode")
+    def wait(self, uuid: str) -> None:
+        # poll-count budget (not wall clock) so an injected no-op sleep
+        # still terminates and the timeout path is testable
+        for _ in range(max(1, POLL_BUDGET_S // POLL_INTERVAL_S)):
+            resp = self.transport(
+                "GET", f"{API_BASE}/analyses/{uuid}", None, self._auth_headers()
+            )
+            status = resp.get("status", "").lower()
+            if status == "finished":
+                return
+            if status == "error":
+                raise CriticalError(f"MythX analysis {uuid} failed")
+            self.sleep(POLL_INTERVAL_S)
+        raise CriticalError(f"MythX analysis {uuid} timed out")
 
-    client = pythx.Client(eth_address=eth_address, password=password)
+    def issues(self, uuid: str) -> List[dict]:
+        resp = self.transport(
+            "GET",
+            f"{API_BASE}/analyses/{uuid}/issues",
+            None,
+            self._auth_headers(),
+        )
+        out = []
+        for report in resp if isinstance(resp, list) else [resp]:
+            out.extend(report.get("issues", []))
+        return out
+
+
+def _issue_offset(raw: dict) -> int:
+    for location in raw.get("locations", []):
+        source_map = location.get("sourceMap", "")
+        head = source_map.split(";")[0].split(":")[0]
+        if head.isdigit():
+            return int(head)
+    return 0
+
+
+def map_issue(raw: dict, contract_name: str) -> Issue:
+    """MythX wire issue -> this framework's Issue."""
+    swc_id = (raw.get("swcID") or "").replace("SWC-", "")
+    return Issue(
+        contract=contract_name,
+        function_name="unknown",
+        address=_issue_offset(raw),
+        swc_id=swc_id,
+        title=raw.get("swcTitle") or raw.get("descriptionShort", ""),
+        bytecode="",
+        severity=(raw.get("severity") or "Unknown").capitalize(),
+        description_head=raw.get("descriptionShort", ""),
+        description_tail=raw.get("descriptionLong", ""),
+    )
+
+
+def analyze(
+    contracts,
+    analysis_mode: str = "quick",
+    client: Optional[MythXClient] = None,
+) -> List[Issue]:
+    """Submit contracts to MythX and return mapped issues."""
+    client = client or MythXClient()
     issues: List[Issue] = []
     for contract in contracts:
-        resp = client.analyze(
-            bytecode="0x" + (contract.creation_code or contract.code),
-        )
-        while not client.analysis_ready(resp.uuid):
-            import time
-
-            time.sleep(3)
-        for report in client.report(resp.uuid):
-            for mythx_issue in getattr(report, "issues", []):
-                issues.append(
-                    Issue(
-                        contract=contract.name,
-                        function_name="unknown",
-                        address=(
-                            mythx_issue.locations[0].source_map.components[0].offset
-                            if mythx_issue.locations
-                            else 0
-                        ),
-                        swc_id=mythx_issue.swc_id.replace("SWC-", ""),
-                        title=mythx_issue.swc_title or mythx_issue.description_short,
-                        bytecode="",
-                        severity=mythx_issue.severity.name.capitalize(),
-                        description_head=mythx_issue.description_short,
-                        description_tail=mythx_issue.description_long,
-                    )
-                )
+        code = contract.creation_code or contract.code
+        if code.startswith("0x"):
+            code = code[2:]
+        uuid = client.submit("0x" + code, analysis_mode)
+        client.wait(uuid)
+        for raw in client.issues(uuid):
+            issues.append(map_issue(raw, contract.name))
     return issues

@@ -1,0 +1,82 @@
+"""Round-trip test for the single-buffer StateBatch serialization.
+
+Pins down the byte layout (field order + little-endian bitcasts) that
+transfer.py relies on in both directions.
+"""
+
+import numpy as np
+
+from mythril_tpu.laser.tpu import transfer
+from mythril_tpu.laser.tpu.batch import BatchConfig, batch_shapes
+
+
+def small_cfg():
+    return BatchConfig(
+        lanes=8,
+        stack_slots=8,
+        memory_bytes=64,
+        calldata_bytes=32,
+        storage_slots=4,
+        code_len=64,
+        tape_slots=16,
+        path_slots=8,
+        mem_sym_slots=4,
+    )
+
+
+def random_batch(cfg, tape_len=None, zero_groups=()):
+    """Random planes; ``tape_len`` caps the tape rows (rows past it are
+    zeroed, per the dead-row invariant) so the slice/pad path runs;
+    ``zero_groups`` empties whole upload groups to hit the skip path."""
+    rng = np.random.default_rng(1)
+    np_batch = {}
+    zero_planes = {
+        p for g in zero_groups for p in transfer._UP_GROUPS[g]
+    }
+    for name, (shape, dtype) in batch_shapes(cfg).items():
+        if name in zero_planes:
+            np_batch[name] = np.zeros(shape, dtype)
+        elif dtype == np.bool_:
+            np_batch[name] = rng.integers(0, 2, shape).astype(bool)
+        else:
+            np_batch[name] = rng.integers(
+                0, np.iinfo(dtype).max, shape, dtype=dtype
+            )
+    if tape_len is not None and "symbolic" not in zero_groups:
+        np_batch["tape_len"] = np.full(
+            (cfg.lanes,), tape_len, np.int32
+        )
+        for f in transfer._TAPE_PLANES:
+            np_batch[f][:, tape_len:] = 0
+    return np_batch
+
+
+def roundtrip(cfg, np_batch):
+    st = transfer.batch_to_device(np_batch, cfg)
+    for name, arr in np_batch.items():
+        assert np.array_equal(np.asarray(getattr(st, name)), arr), name
+    back = transfer.batch_to_host(st)
+    for name, arr in np_batch.items():
+        if name in transfer._SKIP_DOWN:
+            assert not np.any(getattr(back, name))  # rebuilt as zeros
+        else:
+            assert np.array_equal(getattr(back, name), arr), name
+
+
+def test_roundtrip_full():
+    cfg = small_cfg()
+    roundtrip(cfg, random_batch(cfg))
+
+
+def test_roundtrip_tape_sliced():
+    # tape_len below the smallest bucket forces the slice-on-upload,
+    # pad-on-device, slice-on-download, pad-on-host paths to do work
+    cfg = small_cfg()._replace(tape_slots=64)
+    assert 16 in transfer._TAPE_BUCKETS and 16 < 64
+    roundtrip(cfg, random_batch(cfg, tape_len=5))
+
+
+def test_roundtrip_groups_skipped():
+    cfg = small_cfg()
+    for groups in (("symbolic",), ("memory", "storage"), tuple(transfer._UP_GROUPS)):
+        roundtrip(cfg, random_batch(cfg, zero_groups=groups))

@@ -1,0 +1,80 @@
+"""MythX client protocol tests over a scripted transport (no network).
+
+Drives login -> submit -> poll -> fetch-issues -> Issue mapping against
+canned API responses, mirroring the flow the reference delegates to the
+``pythx`` package (reference mythril/mythx/__init__.py).
+"""
+
+import pytest
+
+import mythril_tpu.mythx as mythx
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.exceptions import CriticalError
+
+
+class ScriptedTransport:
+    def __init__(self, statuses=("finished",)):
+        self.calls = []
+        self.statuses = list(statuses)
+
+    def __call__(self, method, url, body, headers):
+        self.calls.append((method, url, body, dict(headers)))
+        if url.endswith("/auth/login"):
+            assert method == "POST"
+            return {"jwt": {"access": "tok123"}}
+        if url.endswith("/analyses"):
+            assert headers["Authorization"] == "Bearer tok123"
+            assert body["data"]["bytecode"].startswith("0x")
+            return {"uuid": "ab-12"}
+        if url.endswith("/analyses/ab-12"):
+            return {"status": self.statuses.pop(0)}
+        if url.endswith("/analyses/ab-12/issues"):
+            return [
+                {
+                    "issues": [
+                        {
+                            "swcID": "SWC-107",
+                            "swcTitle": "Reentrancy",
+                            "severity": "high",
+                            "descriptionShort": "External call",
+                            "descriptionLong": "A call to an external...",
+                            "locations": [{"sourceMap": "12:1:0"}],
+                        }
+                    ]
+                }
+            ]
+        raise AssertionError(f"unexpected url {url}")
+
+
+def make_contract():
+    return EVMContract(code="0x6001", creation_code="0x600160015500", name="C")
+
+
+def test_analyze_end_to_end():
+    transport = ScriptedTransport(statuses=("in progress", "finished"))
+    client = mythx.MythXClient(transport=transport, sleep=lambda _s: None)
+    issues = mythx.analyze([make_contract()], client=client)
+    assert len(issues) == 1
+    issue = issues[0]
+    assert issue.swc_id == "107"
+    assert issue.severity == "High"
+    assert issue.address == 12
+    assert issue.title == "Reentrancy"
+    # login happened exactly once despite several authed calls
+    logins = [c for c in transport.calls if c[1].endswith("/auth/login")]
+    assert len(logins) == 1
+
+
+def test_analysis_error_raises():
+    transport = ScriptedTransport(statuses=("error",))
+    client = mythx.MythXClient(transport=transport, sleep=lambda _s: None)
+    with pytest.raises(CriticalError):
+        mythx.analyze([make_contract()], client=client)
+
+
+def test_trial_credentials_default(monkeypatch):
+    monkeypatch.delenv("MYTHX_ETH_ADDRESS", raising=False)
+    monkeypatch.delenv("MYTHX_PASSWORD", raising=False)
+    client = mythx.MythXClient(transport=ScriptedTransport())
+    assert client.eth_address == mythx.TRIAL_ETH_ADDRESS
+    assert client.password == mythx.TRIAL_PASSWORD

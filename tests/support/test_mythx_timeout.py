@@ -1,0 +1,21 @@
+"""The poll budget must terminate even with an injected no-op sleep."""
+
+import pytest
+
+import mythril_tpu.mythx as mythx
+from mythril_tpu.exceptions import CriticalError
+
+
+def test_wait_times_out_with_stub_sleep():
+    calls = []
+
+    def transport(method, url, body, headers):
+        if url.endswith("/auth/login"):
+            return {"jwt": {"access": "t"}}
+        calls.append(url)
+        return {"status": "queued"}
+
+    client = mythx.MythXClient(transport=transport, sleep=lambda _s: None)
+    with pytest.raises(CriticalError, match="timed out"):
+        client.wait("u1")
+    assert len(calls) == mythx.POLL_BUDGET_S // mythx.POLL_INTERVAL_S

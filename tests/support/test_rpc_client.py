@@ -1,0 +1,95 @@
+"""JSON-RPC client tests over a mocked HTTP session (no network).
+
+Exercises the request framing, result extraction, error mapping, and
+the eth_* convenience wrappers the DynLoader uses for on-chain analysis
+(parity: reference mythril/ethereum/interface/rpc/client.py).
+"""
+
+import json
+
+import pytest
+
+from mythril_tpu.ethereum.interface.rpc.client import (
+    EthJsonRpc,
+    validate_block,
+)
+from mythril_tpu.ethereum.interface.rpc.exceptions import (
+    BadJsonError,
+    BadResponseError,
+    BadStatusCodeError,
+)
+
+
+class FakeResponse:
+    def __init__(self, status_code=200, payload=None, text=""):
+        self.status_code = status_code
+        self._payload = payload
+        self.text = text
+
+    def json(self):
+        if self._payload is None:
+            raise ValueError("not json")
+        return self._payload
+
+
+class FakeSession:
+    def __init__(self, response):
+        self.response = response
+        self.requests = []
+
+    def post(self, url, headers=None, data=None, timeout=None):
+        self.requests.append((url, json.loads(data)))
+        return self.response
+
+
+def client_with(response):
+    client = EthJsonRpc("node.example", 8545)
+    client.session = FakeSession(response)
+    return client
+
+
+def test_eth_get_code_framing_and_result():
+    client = client_with(
+        FakeResponse(payload={"jsonrpc": "2.0", "id": 1, "result": "0x6001"})
+    )
+    assert client.eth_getCode("0x" + "11" * 20) == "0x6001"
+    url, body = client.session.requests[0]
+    assert url == "http://node.example:8545"
+    assert body["method"] == "eth_getCode"
+    assert body["params"] == ["0x" + "11" * 20, "latest"]
+
+
+def test_hex_decoding_wrappers():
+    client = client_with(
+        FakeResponse(payload={"jsonrpc": "2.0", "id": 1, "result": "0x10"})
+    )
+    assert client.eth_blockNumber() == 16
+    assert client.eth_getBalance("0x" + "22" * 20) == 16
+    assert client.eth_getTransactionCount("0x" + "22" * 20, block=7) == 16
+    # int block specifiers become hex quantities on the wire
+    assert client.session.requests[-1][1]["params"][1] == "0x7"
+
+
+def test_error_mapping():
+    with pytest.raises(BadStatusCodeError):
+        client_with(FakeResponse(status_code=500)).eth_blockNumber()
+    with pytest.raises(BadJsonError):
+        client_with(FakeResponse(text="<html>")).eth_blockNumber()
+    with pytest.raises(BadResponseError):
+        client_with(
+            FakeResponse(payload={"error": {"code": -32000, "message": "x"}})
+        ).eth_blockNumber()
+    with pytest.raises(BadResponseError):
+        client_with(FakeResponse(payload={"jsonrpc": "2.0"})).eth_blockNumber()
+
+
+def test_validate_block():
+    assert validate_block("latest") == "latest"
+    assert validate_block(255) == "0xff"
+    with pytest.raises(ValueError):
+        validate_block("tip")
+
+
+def test_infura_style_url():
+    client = EthJsonRpc("mainnet.infura.io/v3/abc", None, tls=True)
+    assert client._url == "https://mainnet.infura.io/v3/abc"

@@ -29,6 +29,16 @@ def creation_of(runtime_hex: str) -> str:
 def myth(*argv, timeout=900):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # Drop the axon sitecustomize from the subprocess: it dials the
+    # single-tenant TPU tunnel at interpreter start regardless of
+    # JAX_PLATFORMS, so a held/wedged tunnel would block these CPU-only
+    # tests (conftest.py deregisters the backend in-process for the same
+    # reason, but that cannot reach a fresh interpreter).
+    env["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
     proc = subprocess.run(
         [sys.executable, MYTH, *argv],
         capture_output=True,
