@@ -243,10 +243,29 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     res = _sel(res, opmask(0x17), a | b)
     res = _sel(res, opmask(0x18), a ^ b)
     res = _sel(res, opmask(0x19), words.bit_not(a))
-    res = _sel(res, opmask(0x1A), words.byte_word(a, b))
-    res = _sel(res, opmask(0x1B), words.shl(a, b))
-    res = _sel(res, opmask(0x1C), words.shr(a, b))
-    res = _sel(res, opmask(0x1D), words.sar(a, b))
+
+    # the shift networks (16-digit barrel shifts x3) are the costliest
+    # always-on family after div/keccak; gate them on any-lane like div
+    shift_mask = opmask(0x1A, 0x1B, 0x1C, 0x1D)
+
+    def do_shifts(_):
+        r = jnp.zeros_like(a)
+        r = _sel(r, opmask(0x1A), words.byte_word(a, b))
+        r = _sel(r, opmask(0x1B), words.shl(a, b))
+        r = _sel(r, opmask(0x1C), words.shr(a, b))
+        r = _sel(r, opmask(0x1D), words.sar(a, b))
+        return r
+
+    res = _sel(
+        res,
+        shift_mask,
+        jax.lax.cond(
+            jnp.any(shift_mask & running),
+            do_shifts,
+            lambda _: jnp.zeros_like(a),
+            None,
+        ),
+    )
 
     # MUL is a 256-entry product sum; cheap enough to keep unconditional.
     is_mul = opmask(0x02)
