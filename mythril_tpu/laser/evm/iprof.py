@@ -1,5 +1,10 @@
 """Per-opcode wall-time profiler (reference surface:
-mythril/laser/ethereum/iprof.py), enabled by --enable-iprof."""
+mythril/laser/ethereum/iprof.py), enabled by --enable-iprof.
+
+Host-executed instructions get exact per-call wall times. Instructions
+retired inside a batched device round have no individual timings, so
+the tpu-batch backend feeds per-opcode retire COUNTS plus the round's
+wall time; those render as an amortized section below the host one."""
 
 from collections import defaultdict
 from typing import Dict, List
@@ -10,9 +15,19 @@ class InstructionProfiler:
 
     def __init__(self):
         self.records: Dict[str, List[float]] = defaultdict(list)
+        self.device_counts: Dict[str, int] = defaultdict(int)
+        self.device_time = 0.0
 
     def record(self, op: str, start: float, end: float) -> None:
         self.records[op].append(end - start)
+
+    def record_device_round(
+        self, counts: Dict[str, int], wall_time: float
+    ) -> None:
+        """Merge one device round: opcode -> retired count, round wall."""
+        for op, count in counts.items():
+            self.device_counts[op] += count
+        self.device_time += wall_time
 
     def __repr__(self) -> str:
         total = 0.0
@@ -25,4 +40,16 @@ class InstructionProfiler:
                 % (op, 0, len(durations), s, s / len(durations), min(durations), max(durations))
             )
         header = "Total: %f s\n" % total
-        return header + "\n".join(lines)
+        out = header + "\n".join(lines)
+        if self.device_counts:
+            retired = sum(self.device_counts.values())
+            amortized = self.device_time / max(retired, 1)
+            dev_lines = [
+                "[%-12s] nr %d" % (op, n)
+                for op, n in sorted(self.device_counts.items())
+            ]
+            out += (
+                "\nDevice rounds: %f s, %d instructions retired "
+                "(amortized %f s/instr)\n" % (self.device_time, retired, amortized)
+            ) + "\n".join(dev_lines)
+        return out

@@ -27,6 +27,7 @@ the host path — the loop degrades gracefully to pure host execution.
 """
 
 import logging
+import time
 from datetime import datetime, timedelta
 from typing import List, Optional
 
@@ -40,7 +41,7 @@ from mythril_tpu.laser.tpu.batch import (
     default_env,
 )
 from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
-from mythril_tpu.laser.tpu.engine import run
+from mythril_tpu.laser.tpu.engine import run, run_with_stats
 from mythril_tpu.laser.tpu import solver_jax, transfer
 from mythril_tpu.support.opcodes import OPCODES
 
@@ -178,8 +179,11 @@ def warmup_device(cfg: BatchConfig) -> None:
         np_batch["tape_op"][0, 0] = 1
         st = transfer.batch_to_device(np_batch, cfg)
         cb = make_code_bank([b"\x00"], cfg.code_len, host_ops=(), freeze_errors=True)
-        out = _run_device(cb, st, cfg)
-        transfer.batch_to_host(out)
+        out, _hist = _run_device(cb, st, cfg, want_stats=True)
+        # both jit specializations (with/without the opcode histogram)
+        # must be warm: which one the hot loop uses depends on iprof
+        out2, _ = _run_device(cb, out, cfg, want_stats=False)
+        transfer.batch_to_host(out2)
         from mythril_tpu.smt import terms as _terms
 
         warm_formula = [_terms.bool_eq(_terms.bv_var("!warmup", 8), _terms.bv_const(1, 8))]
@@ -208,11 +212,28 @@ def _use_mesh(n_devices: int, platform: str) -> bool:
     return n_devices > 1 and platform != "cpu"
 
 
-def _run_device(cb, st, cfg):
+_mesh_stats_warned = [False]
+
+
+def _warn_mesh_stats_once() -> None:
+    if not _mesh_stats_warned[0]:
+        _mesh_stats_warned[0] = True
+        log.warning(
+            "instruction profiling of device rounds is not collected on "
+            "the multi-device mesh path; the profiler will only show "
+            "host-executed opcodes"
+        )
+
+
+def _run_device(cb, st, cfg, want_stats=False):
     """Run the packed batch to quiescence: single-device fast path, or —
     with more than one visible device — lane-sharded SPMD over a mesh with
     occupancy-gated all-to-all rebalancing (SURVEY §5 distributed backend;
-    the production wiring of mesh.round_impl that the dryrun exercises)."""
+    the production wiring of mesh.round_impl that the dryrun exercises).
+
+    Returns ``(state, op_hist_or_None)``; the u32[256] retired-opcode
+    histogram feeds the instruction profiler and is only produced on the
+    single-device path (``want_stats``)."""
     import jax
 
     from mythril_tpu.laser.tpu import mesh as mesh_lib
@@ -224,7 +245,13 @@ def _run_device(cb, st, cfg):
         not _use_mesh(n_shards, devices[0].platform)
         or cfg.lanes % n_shards != 0
     ):
-        return run(cb, default_env(), st, max_steps=DEVICE_STEP_BUDGET)
+        if want_stats:
+            return run_with_stats(
+                cb, default_env(), st, max_steps=DEVICE_STEP_BUDGET
+            )
+        return run(cb, default_env(), st, max_steps=DEVICE_STEP_BUDGET), None
+    if want_stats:
+        _warn_mesh_stats_once()
 
     mesh = mesh_lib.make_mesh()
     st = mesh_lib.shard_batch(st, mesh)
@@ -243,7 +270,7 @@ def _run_device(cb, st, cfg):
         steps_done += MESH_STEPS_PER_ROUND
         if not bool(np.asarray(st.alive & (st.status == _RUNNING)).any()):
             break
-    return st
+    return st, None
 
 
 def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
@@ -406,10 +433,28 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             continue
 
         cb, st = bridge.finish()
-        out = _run_device(cb, st, cfg)
+        round_start = time.time()
+        out, op_hist = _run_device(
+            cb, st, cfg, want_stats=laser.iprof is not None
+        )
         # one download: everything below (step counters, coverage merge,
         # per-lane unpack/lift) reads the host view for free
         out = transfer.batch_to_host(out)
+        if op_hist is not None and laser.iprof is not None:
+            hist = np.asarray(op_hist)
+            counts = {
+                (
+                    OPCODES[op_byte].name
+                    if op_byte in OPCODES
+                    else f"0x{op_byte:02x}"
+                ): int(n)
+                for op_byte, n in enumerate(hist)
+                if n
+            }
+            if counts:
+                laser.iprof.record_device_round(
+                    counts, time.time() - round_start
+                )
         strategy.device_rounds += 1
         strategy.device_steps_retired += int(np.asarray(out.steps).sum())
 

@@ -1057,17 +1057,55 @@ def _byte_length(w):
     return jnp.where(any_nz, 2 * h + dbytes, 0).astype(U32)
 
 
-@partial(jax.jit, static_argnames=("max_steps",), donate_argnames=("st",))
-def run(cb: CodeBank, env: Env, st: StateBatch, max_steps: int = 4096):
-    """Advance the batch until every lane halts/traps or max_steps."""
+@partial(
+    jax.jit, static_argnames=("max_steps", "with_stats"), donate_argnames=("st",)
+)
+def _run_impl(
+    cb: CodeBank,
+    env: Env,
+    st: StateBatch,
+    max_steps: int = 4096,
+    with_stats: bool = False,
+):
+    """Advance the batch until every lane halts/traps or max_steps.
+
+    With ``with_stats``, also accumulate a u32[256] histogram of opcodes
+    retired across all lanes — the device-side feed for the instruction
+    profiler (the host's per-opcode wall times cannot exist for batched
+    execution; counts plus the round's wall time give the amortized
+    equivalent). Derived purely from observable state (a lane retired
+    code[pc] iff its step counter advanced), so the step kernel itself
+    stays unchanged. One body, two jit specializations."""
+    CL = cb.code.shape[1]
 
     def cond(carry):
-        t, s = carry
+        t, s, _hist = carry
         return (t < max_steps) & jnp.any(s.alive & (s.status == RUNNING))
 
     def body(carry):
-        t, s = carry
-        return t + 1, step(cb, env, s)
+        t, s, hist = carry
+        ns = step(cb, env, s)
+        if with_stats:
+            op = cb.code[s.code_id, jnp.clip(s.pc, 0, CL - 1)].astype(I32)
+            idx = jnp.where(ns.steps > s.steps, op, 256)  # 256 = dropped
+            hist = hist.at[idx].add(1, mode="drop")
+        return t + 1, ns, hist
 
-    t, out = jax.lax.while_loop(cond, body, (jnp.asarray(0, I32), st))
+    hist0 = jnp.zeros((256 if with_stats else 1,), jnp.uint32)
+    _t, out, hist = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, I32), st, hist0)
+    )
+    return out, hist
+
+
+def run(cb: CodeBank, env: Env, st: StateBatch, max_steps: int = 4096):
+    """Advance the batch until every lane halts/traps or max_steps."""
+    out, _hist = _run_impl(cb, env, st, max_steps=max_steps, with_stats=False)
     return out
+
+
+def run_with_stats(
+    cb: CodeBank, env: Env, st: StateBatch, max_steps: int = 4096
+):
+    """:func:`run` plus the retired-opcode histogram (see _run_impl)."""
+    return _run_impl(cb, env, st, max_steps=max_steps, with_stats=True)
