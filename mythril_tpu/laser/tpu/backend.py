@@ -150,21 +150,18 @@ DEVICE_STEP_BUDGET = 4096
 _warmed_cfgs = set()
 
 
-def warmup_device(cfg: BatchConfig) -> None:
+def warmup_device(cfg: BatchConfig, want_stats: bool = False) -> None:
     """Compile the step kernel (and the batched-solver kernel) for this
     batch config on an empty batch — every lane dead, so execution is a
-    no-op but XLA compiles (and the persistent compile cache fills)."""
-    if cfg in _warmed_cfgs:
+    no-op but XLA compiles (and the persistent compile cache fills).
+    Only the jit specialization the hot loop will use is compiled:
+    ``want_stats`` selects the opcode-histogram variant (exec_batch
+    warms it on demand when the profiler is enabled)."""
+    if (cfg, want_stats) in _warmed_cfgs:
         return
-    _warmed_cfgs.add(cfg)
+    _warmed_cfgs.add((cfg, want_stats))
     try:
-        import jax.numpy as jnp
-
-        from mythril_tpu.laser.tpu.batch import (
-            StateBatch,
-            batch_shapes,
-            make_code_bank,
-        )
+        from mythril_tpu.laser.tpu.batch import batch_shapes, make_code_bank
 
         np_batch = {
             field: np.zeros(shape, dtype)
@@ -179,11 +176,8 @@ def warmup_device(cfg: BatchConfig) -> None:
         np_batch["tape_op"][0, 0] = 1
         st = transfer.batch_to_device(np_batch, cfg)
         cb = make_code_bank([b"\x00"], cfg.code_len, host_ops=(), freeze_errors=True)
-        out, _hist = _run_device(cb, st, cfg, want_stats=True)
-        # both jit specializations (with/without the opcode histogram)
-        # must be warm: which one the hot loop uses depends on iprof
-        out2, _ = _run_device(cb, out, cfg, want_stats=False)
-        transfer.batch_to_host(out2)
+        out, _hist = _run_device(cb, st, cfg, want_stats=want_stats)
+        transfer.batch_to_host(out)
         from mythril_tpu.smt import terms as _terms
 
         warm_formula = [_terms.bool_eq(_terms.bv_var("!warmup", 8), _terms.bv_const(1, 8))]
@@ -371,6 +365,10 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
     host_ops = host_op_bytes(laser)
     seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
     final_states: List[GlobalState] = []
+    if laser.iprof is not None:
+        # profiled runs use the histogram specialization of the run loop;
+        # compile it before the first real round
+        warmup_device(cfg, want_stats=True)
 
     while laser.work_list:
         if (
