@@ -122,7 +122,11 @@ class NativeSat:
         """Whole assignment as a 1-based array (index 0 unused): 1/-1/0."""
         buf = (ctypes.c_byte * self._synced_vars)()
         self._lib.tsat_model_copy(self._s, buf, self._synced_vars)
-        out = array("b", [0]) + array("b", buf)
+        # frombytes on the ctypes buffer is one memcpy; building
+        # array("b", buf) element-wise iterated a ~1M-entry ctypes array
+        # per query and dominated the host engine's profile
+        out = array("b", b"\x00")
+        out.frombytes(buf)
         return out
 
     @property
