@@ -8,7 +8,7 @@ ProbeModule base most built-ins use lives in probe.py."""
 import logging
 from abc import ABC, abstractmethod
 from enum import Enum
-from typing import List, Optional, Set
+from typing import FrozenSet, List, Optional, Set
 
 from mythril_tpu.analysis.report import Issue
 from mythril_tpu.laser.evm.state.global_state import GlobalState
@@ -34,6 +34,11 @@ class DetectionModule(ABC):
     entry_point: EntryPoint = EntryPoint.CALLBACK
     pre_hooks: List[str] = []
     post_hooks: List[str] = []
+    # opcodes whose pre-hook this module can replay over a lifted term
+    # tape (batch-aware mode): when EVERY module hooking an opcode lists
+    # it here, the device retires the opcode instead of freeze-trapping,
+    # and the bridge calls replay_tape_node at lift time
+    tape_replay_hooks: FrozenSet[str] = frozenset()
 
     def __init__(self) -> None:
         self.issues: List[Issue] = []

@@ -13,6 +13,10 @@ class ArbitraryJump(ProbeModule):
     swc_id = ARBITRARY_JUMP
     description = "Search for jumps to arbitrary locations in the bytecode"
     pre_hooks = ["JUMP", "JUMPI"]
+    # a symbolic jump destination traps the lane (frozen BEFORE the jump,
+    # so the host re-executes it with hooks); device-retired jumps are
+    # concrete-dest by construction and can never fire this probe
+    tape_replay_hooks = frozenset({"JUMP", "JUMPI"})
 
     title = "Jump to an arbitrary instruction"
     severity = "High"

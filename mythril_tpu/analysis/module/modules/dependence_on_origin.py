@@ -18,6 +18,10 @@ class TxOrigin(ProbeModule):
     description = "Check whether control flow decisions are influenced by tx.origin"
     pre_hooks = ["JUMPI"]
     post_hooks = ["ORIGIN"]
+    # the JUMPI probe only reads the condition's taint annotations, which
+    # survive pack/lift; the bridge replays it at branch sites the device
+    # retired (ORIGIN itself stays host-hooked and taints at the source)
+    tape_replay_hooks = frozenset({"JUMPI"})
 
     title = "Dependence on tx.origin"
     severity = "Low"

@@ -47,6 +47,9 @@ class PredictableVariables(ProbeModule):
     )
     pre_hooks = ["JUMPI", "BLOCKHASH"]
     post_hooks = ["BLOCKHASH"] + list(BLOCK_VARIABLE_OPS)
+    # JUMPI reads condition taints only -> replayable at lift time; the
+    # taint sources (block-var reads, BLOCKHASH) stay host-hooked
+    tape_replay_hooks = frozenset({"JUMPI"})
 
     title = "Dependence on predictable environment variable"
     severity = "Low"

@@ -140,6 +140,13 @@ class IntegerArithmetics(DetectionModule):
         "RETURN",
         "CALL",
     ]
+    # the arithmetic hooks only tag the operand value with a hazard; the
+    # tag reconstructs exactly from a lifted tape node, so arithmetic can
+    # retire on device (sinks and settlement stay host-hooked). Known
+    # approximation: the device tape CSE-merges identical (op, operands)
+    # nodes per lane, so arithmetic the host would tag at several sites
+    # replays once, at the first site (compilers CSE such code anyway)
+    tape_replay_hooks = frozenset({"ADD", "MUL", "EXP", "SUB", "JUMPI"})
 
     def __init__(self) -> None:
         super().__init__()
@@ -176,8 +183,11 @@ class IntegerArithmetics(DetectionModule):
 
     def _tag_arithmetic(self, state, opcode: str) -> None:
         stack = state.mstate.stack
-        lhs = _as_bitvec(stack, -1)
-        rhs = _as_bitvec(stack, -2)
+        self._tag_operands(state, opcode, _as_bitvec(stack, -1), _as_bitvec(stack, -2))
+
+    def _tag_operands(self, origin, opcode: str, lhs, rhs) -> None:
+        """Attach the wrap-hazard annotation; shared by the host hook and
+        the tape replay (``origin`` is a GlobalState or a TapeOrigin)."""
         if opcode == "ADD":
             operator, wrap = "addition", Not(BVAddNoOverflow(lhs, rhs, False))
         elif opcode == "SUB":
@@ -189,7 +199,14 @@ class IntegerArithmetics(DetectionModule):
             wrap = _exp_wrap_condition(lhs, rhs)
             if wrap is None:
                 return
-        lhs.annotate(OverflowHazard(state, operator, wrap))
+        lhs.annotate(OverflowHazard(origin, operator, wrap))
+
+    def replay_tape_node(self, origin, opcode: str, lhs, rhs) -> None:
+        """Batch-aware form of the arithmetic pre-hooks (see
+        tape_replay_hooks): identical tagging over lifted operand terms."""
+        if lhs is None or rhs is None:
+            return
+        self._tag_operands(origin, opcode, lhs, rhs)
 
     def _collect_return_data(self, state) -> None:
         stack = state.mstate.stack

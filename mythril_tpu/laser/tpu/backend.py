@@ -119,25 +119,74 @@ def find_tpu_strategy(strategy) -> Optional[TpuBatchStrategy]:
     return None
 
 
+def _replayable_pre_hook(name: str, hooks) -> bool:
+    """True when every pre-hook on ``name`` belongs to a detection module
+    that can replay it over the lifted term tape (batch-aware mode)."""
+    for hook in hooks:
+        owner = getattr(hook, "__self__", None)
+        if owner is None or name not in getattr(
+            owner, "tape_replay_hooks", frozenset()
+        ):
+            return False
+    return True
+
+
 def host_op_bytes(laser) -> set:
-    """Opcode bytes that must freeze-trap back to the host loop."""
+    """Opcode bytes that must freeze-trap back to the host loop.
+
+    An opcode whose every pre-hook is tape-replayable (and that has no
+    post-hooks) retires on device; the bridge replays the hooks over the
+    lifted tape at unpack time."""
     hooked = set()
-    for name, hooks in list(laser.pre_hooks.items()) + list(laser.post_hooks.items()):
+    for name, hooks in laser.pre_hooks.items():
         if not hooks:
             continue
-        base = name
-        byte = _NAME_TO_BYTE.get(base)
+        if name == "*":
+            return set(range(256))
+        if _replayable_pre_hook(name, hooks) and not laser.post_hooks.get(name):
+            continue
+        byte = _NAME_TO_BYTE.get(name)
         if byte is not None:
             hooked.add(byte)
-        # hook names like LOG0..LOG4 / PUSH1.. resolve individually; a
-        # wildcard registration hooks everything
-        if base == "*":
+    for name, hooks in laser.post_hooks.items():
+        if not hooks:
+            continue
+        if name == "*":
             return set(range(256))
+        byte = _NAME_TO_BYTE.get(name)
+        if byte is not None:
+            hooked.add(byte)
     for name in _ALWAYS_HOST:
         byte = _NAME_TO_BYTE.get(name)
         if byte is not None:
             hooked.add(byte)
     return hooked
+
+
+def tape_replayers_for(laser) -> dict:
+    """Replay dispatch for every opcode the hook exclusion in
+    host_op_bytes lets retire on device: symtape node op ->
+    [(module, opcode name)] for the arithmetic family (per-tape-node
+    replay), plus the string key "JUMPI" for branch-site replay over the
+    path tape (bridge._replay_jumpi_sites)."""
+    from mythril_tpu.laser.tpu import symtape
+
+    mapping = {
+        "ADD": symtape.OP_ADD,
+        "SUB": symtape.OP_SUB,
+        "MUL": symtape.OP_MUL,
+        "EXP": symtape.OP_EXP,
+        "JUMPI": "JUMPI",
+    }
+    out: dict = {}
+    for name, hooks in laser.pre_hooks.items():
+        if name not in mapping or not hooks:
+            continue
+        if not _replayable_pre_hook(name, hooks) or laser.post_hooks.get(name):
+            continue
+        for hook in hooks:
+            out.setdefault(mapping[name], []).append((hook.__self__, name))
+    return out
 
 
 # frontiers below this size are cheaper on the warm host CDCL than through
@@ -363,6 +412,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
     strategy = find_tpu_strategy(laser.strategy)
     cfg = strategy.batch_cfg
     host_ops = host_op_bytes(laser)
+    replayers = tape_replayers_for(laser)
     seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
     final_states: List[GlobalState] = []
     if laser.iprof is not None:
@@ -418,7 +468,12 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         overflow = survivors[seed_cap:]
         laser.work_list.extend(overflow)
 
-        bridge = DeviceBridge(cfg, host_ops=host_ops, freeze_errors=True)
+        bridge = DeviceBridge(
+            cfg,
+            host_ops=host_ops,
+            freeze_errors=True,
+            tape_replayers=replayers,
+        )
         packed_states = []
         for state in to_pack:
             try:

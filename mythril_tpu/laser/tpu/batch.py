@@ -121,9 +121,11 @@ class StateBatch(NamedTuple):
     tape_imm: jnp.ndarray  # u32[L, T, 16]
     tape_h1: jnp.ndarray  # u32[L, T] node identity hashes: the device
     tape_h2: jnp.ndarray  # u32[L, T] CSE scan compares only these planes
+    tape_meta: jnp.ndarray  # u32[L, T] allocation-site pc|path_len (symtape.pack_meta)
     tape_len: jnp.ndarray  # i32[L]
     path_id: jnp.ndarray  # i32[L, P] branch-condition tape ids
     path_sign: jnp.ndarray  # bool[L, P] True = condition word != 0
+    path_meta: jnp.ndarray  # u32[L, P] symtape.pack_meta of the appending JUMPI (host pack appends no entries)
     path_len: jnp.ndarray  # i32[L]
     msym_off: jnp.ndarray  # i32[L, MS] byte offset of a symbolic mem word
     msym_id: jnp.ndarray  # i32[L, MS]
@@ -187,9 +189,11 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "tape_imm": ((L, T, D), np.uint32),
         "tape_h1": ((L, T), np.uint32),
         "tape_h2": ((L, T), np.uint32),
+        "tape_meta": ((L, T), np.uint32),
         "tape_len": ((L,), np.int32),
         "path_id": ((L, P), np.int32),
         "path_sign": ((L, P), np.bool_),
+        "path_meta": ((L, P), np.uint32),
         "path_len": ((L,), np.int32),
         "msym_off": ((L, MS), np.int32),
         "msym_id": ((L, MS), np.int32),
@@ -298,6 +302,7 @@ def append_node(np_batch: dict, lane: int, op: int, a: int = 0, b: int = 0, imm=
     h1, h2 = symtape.node_hash(op, a, b, imm_row, xp=np)
     np_batch["tape_h1"][lane, n] = h1
     np_batch["tape_h2"][lane, n] = h2
+    np_batch["tape_meta"][lane, n] = symtape.HOST_META
     np_batch["tape_len"][lane] = n + 1
     return n + 1
 
@@ -354,8 +359,9 @@ def _fill_lane(
     # symbolic layer resets
     for f in (
         "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1",
-        "tape_h2", "tape_len",
-        "path_id", "path_sign", "path_len", "msym_off", "msym_id",
+        "tape_h2", "tape_meta", "tape_len",
+        "path_id", "path_sign", "path_meta", "path_len", "msym_off",
+        "msym_id",
         "msym_used", "skey_sym", "sval_sym", "cdsize_sym", "caller_sym",
         "callvalue_sym", "origin_sym", "balance_sym",
     ):

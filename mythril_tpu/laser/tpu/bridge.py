@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mythril_tpu.analysis.module.module_helpers import forced_hook_phase
 from mythril_tpu.laser.evm import util as evm_util
 from mythril_tpu.laser.evm.keccak_function_manager import keccak_function_manager
 from mythril_tpu.laser.evm.state.calldata import ConcreteCalldata
@@ -62,6 +63,38 @@ log = logging.getLogger(__name__)
 
 class PackError(Exception):
     """The state cannot be represented in the device model."""
+
+
+class _TapeWorldState:
+    """Lazy stand-in for a world state: only ``constraints`` is real."""
+
+    def __init__(self, constraints_fn):
+        self._fn = constraints_fn
+        self._constraints = None
+
+    @property
+    def constraints(self):
+        if self._constraints is None:
+            self._constraints = self._fn()
+        return self._constraints
+
+
+class TapeOrigin:
+    """Origin view of a device-retired instruction for detection replays.
+
+    Carries exactly what the hook-path modules read from their origin
+    ``GlobalState``: the instruction address, the environment (shared
+    with the seed — code/account/function name are lane-invariant), and
+    the constraints in force at the origin (materialized lazily; most
+    hazards are never solved)."""
+
+    def __init__(self, pc: int, seed: GlobalState, constraints_fn):
+        self.environment = seed.environment
+        self.world_state = _TapeWorldState(constraints_fn)
+        self._instruction = {"address": pc, "opcode": None}
+
+    def get_current_instruction(self) -> dict:
+        return self._instruction
 
 
 # host term op -> (device op, commutes-with-EVM-order)
@@ -98,10 +131,21 @@ class DeviceBridge:
     ``opaque`` carries host terms referenced by OPAQUE leaves.
     """
 
-    def __init__(self, cfg: BatchConfig, host_ops=None, freeze_errors: bool = False):
+    def __init__(
+        self,
+        cfg: BatchConfig,
+        host_ops=None,
+        freeze_errors: bool = False,
+        tape_replayers=None,
+    ):
         self.cfg = cfg
         self.host_ops = host_ops
         self.freeze_errors = freeze_errors
+        # symtape op -> [(detection module, EVM opcode name)]: batch-aware
+        # modules whose pre-hook is replayed over device-allocated tape
+        # nodes at lift time instead of freeze-trapping the opcode
+        self.tape_replayers = tape_replayers or {}
+        self.packed_tape_len: List[int] = []
         self.seeds: List[GlobalState] = []
         self.opaque: List[BitVec] = []
         self._opaque_ids: Dict[int, int] = {}  # term uid -> opaque index
@@ -142,6 +186,7 @@ class DeviceBridge:
             for plane in self._np_batch.values():
                 plane[lane] = 0
             raise
+        self.packed_tape_len.append(int(self._np_batch["tape_len"][lane]))
         self._n_staged += 1
         return lane
 
@@ -488,6 +533,14 @@ class DeviceBridge:
         aa = np.asarray(st.tape_a)[lane]
         bb = np.asarray(st.tape_b)[lane]
         imms = np.asarray(st.tape_imm)[lane]
+        metas = np.asarray(st.tape_meta)[lane]
+        path_ids = np.asarray(st.path_id)[lane]
+        path_signs = np.asarray(st.path_sign)[lane]
+        packed_prefix = (
+            self.packed_tape_len[seed_id_val]
+            if seed_id_val < len(self.packed_tape_len)
+            else n
+        )
         values: List[Optional[BitVec]] = [None] * n
         side: List[Bool] = []
 
@@ -506,6 +559,15 @@ class DeviceBridge:
             x = arg(i, int(aa[i]))
             y = arg(i, int(bb[i]))
             imm_int = words.to_int(imms[i])
+            if (
+                self.tape_replayers
+                and i >= packed_prefix
+                and op in self.tape_replayers
+            ):
+                self._replay_node(
+                    seed, op, i, int(metas[i]), x, y, values, side,
+                    path_ids, path_signs,
+                )
             if op == symtape.OP_OPAQUE:
                 v = BitVec(self.opaque[imm_int])
             elif op == symtape.OP_CDLOAD:
@@ -637,6 +699,59 @@ class DeviceBridge:
     # ------------------------------------------------------------------
     # unpacking
 
+    def _replay_node(
+        self, seed, op, index, meta, x, y, values, side, path_ids, path_signs
+    ) -> None:
+        """Run batch-aware detection hooks for one device-allocated node.
+
+        The module's pre-hook semantics are reproduced over the lifted
+        operand terms: annotations it attaches propagate into every
+        dependent lifted value exactly as they do through host execution,
+        so downstream sink collection (on still-hooked opcodes) and
+        settlement need no changes."""
+        unpacked = symtape.unpack_meta(meta)
+        if unpacked is None:
+            return
+        pc, plen = unpacked
+        # materialize the origin's path-condition terms NOW (they are
+        # already-built earlier tape nodes) so the lazy constraints
+        # closure pins a handful of terms, not the whole lift scope
+        zero = symbol_factory.BitVecVal(0, 256)
+        prefix_conds = []
+        for j in range(plen):
+            node_id = int(path_ids[j])
+            if node_id <= 0 or values[node_id - 1] is None:
+                continue
+            w = values[node_id - 1]
+            prefix_conds.append(
+                Not(w == zero) if path_signs[j] else (w == zero)
+            )
+        seed_constraints = seed.world_state.constraints
+        side_snapshot = list(side)
+        origin = TapeOrigin(
+            pc,
+            seed,
+            lambda: self._origin_constraints(
+                seed_constraints, side_snapshot, prefix_conds
+            ),
+        )
+        for module, opcode_name in self.tape_replayers[op]:
+            try:
+                module.replay_tape_node(origin, opcode_name, x, y)
+            except Exception as e:  # pragma: no cover - module bugs degrade
+                log.warning("tape replay failed (%s): %s", opcode_name, e)
+
+    @staticmethod
+    def _origin_constraints(seed_constraints, side_conds, prefix_conds):
+        """Constraints in force when the node was allocated: the seed's
+        world constraints, keccak side conditions, and the lifted
+        path-condition prefix."""
+        from mythril_tpu.laser.evm.state.constraints import Constraints
+
+        return Constraints(
+            list(seed_constraints) + side_conds + prefix_conds
+        )
+
     def lane_constraints(self, st: StateBatch, lane: int, values, side):
         """The lane's accumulated path condition as host Bools."""
         conds: List[Bool] = list(side)
@@ -753,4 +868,55 @@ class DeviceBridge:
         # path conditions + keccak side conditions
         for cond in self.lane_constraints(st, lane, values, side):
             gs.world_state.constraints.append(cond)
+
+        self._replay_jumpi_sites(gs, st, lane, values)
         return gs
+
+    def _replay_jumpi_sites(self, gs, st, lane, values) -> None:
+        """Run JUMPI pre-hooks of batch-aware modules for every branch
+        the device took on this lane.
+
+        The unpacked state is mutated into the shape the hook expects at
+        the branch site (pc at the JUMPI, ``[cond, dest]`` on top of the
+        stack) and restored afterwards — probe modules snapshot what they
+        report at materialize time, and sink annotations land on the
+        continuing state exactly as a host-fired hook's would. The dest
+        slot is a concrete dummy: device-retired JUMPIs always have
+        concrete destinations (symbolic destinations trap), so
+        dest-sensitive modules see what they would have seen."""
+        replayers = self.tape_replayers.get("JUMPI")
+        if not replayers:
+            return
+        plen = int(np.asarray(st.path_len)[lane])
+        if plen == 0:
+            return
+        path_ids = np.asarray(st.path_id)[lane]
+        path_metas = np.asarray(st.path_meta)[lane]
+        instr_list = gs.environment.code.instruction_list
+        saved_pc, saved_stack = gs.mstate.pc, gs.mstate.stack
+        dest_dummy = symbol_factory.BitVecVal(0, 256)
+        try:
+            for j in range(plen):
+                site = symtape.unpack_meta(int(path_metas[j]))
+                if site is None:
+                    continue
+                pc_byte, _ = site
+                node_id = int(path_ids[j])
+                if node_id <= 0 or values[node_id - 1] is None:
+                    continue
+                pc_index = evm_util.get_instruction_index(instr_list, pc_byte)
+                if pc_index is None:
+                    continue
+                gs.mstate.pc = pc_index
+                gs.mstate.stack = MachineStack(
+                    [values[node_id - 1], dest_dummy]
+                )
+                with forced_hook_phase(prehook=True):
+                    for module, _name in replayers:
+                        try:
+                            module.execute(gs)
+                        except Exception as e:  # pragma: no cover
+                            log.warning("JUMPI replay failed: %s", e)
+        finally:
+            gs.mstate.pc = saved_pc
+            gs.mstate.stack = saved_stack

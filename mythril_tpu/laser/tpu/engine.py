@@ -333,8 +333,9 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # tape node (the concrete operand, if any, rides inline in imm)
     tapes = (
         st.tape_op, st.tape_a, st.tape_b, st.tape_imm,
-        st.tape_h1, st.tape_h2, st.tape_len,
+        st.tape_h1, st.tape_h2, st.tape_meta, st.tape_len,
     )
+    alloc_meta = symtape.pack_meta(st.pc, st.path_len)
     sym_opt = jnp.asarray(symtape.SYM_OP)[op]
     sym_ar = jnp.asarray(symtape.SYM_ARITY)[op]
     alu_sym_mask = (
@@ -349,7 +350,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         both_or_unary[:, None], jnp.zeros_like(a), jnp.where(has_a[:, None], b, a)
     )
     tapes, alu_id, alu_ok = symtape.alloc(
-        tapes, alu_sym_mask, sym_opt, node_a, node_b, imm_alu
+        tapes, alu_sym_mask, sym_opt, node_a, node_b, imm_alu, alloc_meta
     )
 
     # ------------------------------------------------------------------
@@ -412,6 +413,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         cd_node_a,
         zero,
         cd_imm,
+        alloc_meta,
     )
     # symbolic offset into CONCRETE calldata: data-dependent gather, host's job
     cdload_symoff_trap = is_cdload & has_a & ~st.calldata_symbolic
@@ -546,6 +548,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         skey_node_a,
         zero,
         skey_imm,
+        alloc_meta,
     )
     sload_tag = jnp.where(found, loaded_sym, jnp.where(sload_leaf_mask, sload_leaf_id, 0))
 
@@ -651,6 +654,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             comb_a,
             rest,
             comb_imm,
+            alloc_meta,
         )
         rest = jnp.where(active, comb_id, rest)
         sha_ok = sha_ok & comb_ok
@@ -661,6 +665,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         rest,
         zero,
         words.from_u32(b32.astype(U32)),
+        alloc_meta,
     )
     sha_ok = sha_ok & sha3_ok
 
@@ -709,6 +714,13 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
     new_path_sign = st.path_sign.at[lane, pwidx].set(
         jnp.where(path_append, False, st.path_sign[lane, pwidx])
+    )
+    new_path_meta = st.path_meta.at[lane, pwidx].set(
+        jnp.where(
+            path_append,
+            symtape.pack_meta(st.pc, st.path_len),
+            st.path_meta[lane, pwidx],
+        )
     )
     new_path_len = st.path_len + path_append.astype(I32)
 
@@ -918,7 +930,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
 
     (
         tape_op_n, tape_a_n, tape_b_n, tape_imm_n,
-        tape_h1_n, tape_h2_n, tape_len_n,
+        tape_h1_n, tape_h2_n, tape_meta_n, tape_len_n,
     ) = tapes
     status_mask = running  # status/trap bookkeeping applies to all running lanes
     nst = StateBatch(
@@ -970,9 +982,11 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         tape_imm=tape_imm_n,
         tape_h1=tape_h1_n,
         tape_h2=tape_h2_n,
+        tape_meta=tape_meta_n,
         tape_len=merge(tape_len_n, st.tape_len),
         path_id=merge(new_path_id, st.path_id),
         path_sign=merge(new_path_sign, st.path_sign),
+        path_meta=merge(new_path_meta, st.path_meta),
         path_len=merge(new_path_len, st.path_len),
         msym_off=merge(new_msym_off, st.msym_off),
         msym_id=merge(new_msym_id, st.msym_id),

@@ -122,11 +122,32 @@ def node_hash(op, a, b, imm, xp=jnp):
     return run(0x811C9DC5, 0x9E3779B1), run(0x01000193, 0x85EBCA77)
 
 
-def alloc(tapes, mask, op, a, b, imm):
+HOST_META = 0xFFFFFFFF  # tape_meta sentinel: node packed by the host
+
+
+def pack_meta(pc, path_len):
+    """Allocation-site metadata word: pc in the low 16 bits, the path
+    tape length at allocation time above — enough for the batch-aware
+    detection replay to reconstruct a node's origin instruction and the
+    constraint prefix in force there."""
+    return (pc.astype(jnp.uint32) & 0xFFFF) | (
+        path_len.astype(jnp.uint32) << 16
+    )
+
+
+def unpack_meta(meta: int):
+    """(pc, path_len) of a device-allocated node; None for HOST_META."""
+    if meta == HOST_META:
+        return None
+    return int(meta) & 0xFFFF, int(meta) >> 16
+
+
+def alloc(tapes, mask, op, a, b, imm, meta):
     """Append one node per masked lane, with per-lane CSE.
 
     ``tapes`` is ``(tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2,
-    tape_len)``; ``op/a/b`` are [L] i32, ``imm`` is [L, 16] u32. Returns
+    tape_meta, tape_len)``; ``op/a/b`` are [L] i32, ``imm`` is [L, 16]
+    u32, ``meta`` [L] u32 (see :func:`pack_meta`). Returns
     ``(tapes', id1, ok)`` where ``id1`` [L] is the 1-based node id (an
     existing row if an identical node is already on the lane's tape) and
     ``ok`` is False where the tape is full (caller traps the lane).
@@ -145,21 +166,23 @@ def alloc(tapes, mask, op, a, b, imm):
     L = mask.shape[0]
 
     def skip(operands):
-        tapes, _mask, _op, _a, _b, _imm = operands
+        tapes, _mask, _op, _a, _b, _imm, _meta = operands
         return tapes, jnp.zeros((L,), jnp.int32), jnp.ones((L,), jnp.bool_)
 
     def do(operands):
-        tapes, mask, op, a, b, imm = operands
-        return _alloc_impl(tapes, mask, op, a, b, imm)
-
+        tapes, mask, op, a, b, imm, meta = operands
+        return _alloc_impl(tapes, mask, op, a, b, imm, meta)
 
     return jax.lax.cond(
-        jnp.any(mask), do, skip, (tapes, mask, op, a, b, imm)
+        jnp.any(mask), do, skip, (tapes, mask, op, a, b, imm, meta)
     )
 
 
-def _alloc_impl(tapes, mask, op, a, b, imm):
-    tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2, tape_len = tapes
+def _alloc_impl(tapes, mask, op, a, b, imm, meta):
+    (
+        tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2,
+        tape_meta, tape_len,
+    ) = tapes
     L, T = tape_op.shape
     lane = jnp.arange(L)
     slot = jnp.arange(T)[None, :]
@@ -192,6 +215,7 @@ def _alloc_impl(tapes, mask, op, a, b, imm):
     tape_b = put(tape_b, b)
     tape_h1 = put(tape_h1, h1)
     tape_h2 = put(tape_h2, h2)
+    tape_meta = put(tape_meta, meta)
     tape_imm = tape_imm.at[lane, widx].set(
         jnp.where(do_new[:, None], imm, tape_imm[lane, widx])
     )
@@ -200,7 +224,10 @@ def _alloc_impl(tapes, mask, op, a, b, imm):
     id1 = jnp.where(mask, jnp.where(hit, cand, tape_len) + 1, 0)
     ok = ~mask | hit | ~overflow
     return (
-        (tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2, new_len),
+        (
+            tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2,
+            tape_meta, new_len,
+        ),
         id1.astype(jnp.int32),
         ok,
     )

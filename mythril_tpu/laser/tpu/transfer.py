@@ -36,7 +36,10 @@ _SKIP_DOWN = ("tape_h1", "tape_h2")
 # by the plane's static shape; slicing drops all-zero tail rows). The
 # term-tape planes dominate batch bytes, so only they are bucketed —
 # everything else ships full-size, keeping the jit-variant count small.
-_TAPE_PLANES = ("tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1", "tape_h2")
+_TAPE_PLANES = (
+    "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1", "tape_h2",
+    "tape_meta",
+)
 _TAPE_BUCKETS = (16, 64, 256, 1024, 4096)
 
 
@@ -55,7 +58,8 @@ def _bucket(n: int, cap: int) -> int:
 _UP_GROUPS = {
     "symbolic": (
         "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1",
-        "tape_h2", "tape_len", "path_id", "path_sign", "path_len",
+        "tape_h2", "tape_meta", "tape_len", "path_id", "path_sign",
+        "path_meta", "path_len",
         "msym_off", "msym_id", "msym_used", "skey_sym", "sval_sym",
         "calldata_symbolic", "storage_symbolic", "cdsize_sym",
         "caller_sym", "callvalue_sym", "origin_sym", "balance_sym",
@@ -194,6 +198,7 @@ _BIG_DOWN = (
     "tape_a",
     "tape_b",
     "tape_imm",
+    "tape_meta",
 )
 
 
