@@ -112,6 +112,7 @@ class StateBatch(NamedTuple):
     visited: jnp.ndarray  # bool[L, code_len] byte-pcs retired (coverage)
     jd_ring: jnp.ndarray  # i32[L, JD_RING] last JUMPDEST byte-pcs (loop bounds)
     jd_cnt: jnp.ndarray  # i32[L] total JUMPDESTs retired
+    jump_cnt: jnp.ndarray  # i32[L] JUMP/JUMPI retired (the host's depth unit)
     # ---- symbolic layer (laser/tpu/symtape.py). Tags are 1-based tape
     # ids; 0 = concrete (the word/byte planes are authoritative).
     stack_sym: jnp.ndarray  # i32[L, S]
@@ -182,6 +183,7 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "visited": ((L, cfg.code_len), np.bool_),
         "jd_ring": ((L, JD_RING), np.int32),
         "jd_cnt": ((L,), np.int32),
+        "jump_cnt": ((L,), np.int32),
         "stack_sym": ((L, S), np.int32),
         "tape_op": ((L, T), np.int32),
         "tape_a": ((L, T), np.int32),
@@ -356,6 +358,7 @@ def _fill_lane(
     np_batch["visited"][lane] = False
     np_batch["jd_ring"][lane] = 0
     np_batch["jd_cnt"][lane] = 0
+    np_batch["jump_cnt"][lane] = 0
     # symbolic layer resets
     for f in (
         "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1",

@@ -839,9 +839,10 @@ class DeviceBridge:
         gs.mstate.min_gas_used += spent
         gs.mstate.max_gas_used += int(np.asarray(st.gas_spent_max)[lane])
 
-        # device-retired instructions count toward path depth, so --max-depth
+        # device-retired JUMP/JUMPIs count toward path depth (the host's
+        # depth unit is jumps, not instructions), so --max-depth
         # bounds device-explored paths exactly like host-explored ones
-        gs.mstate.depth += int(np.asarray(st.steps)[lane])
+        gs.mstate.depth += int(np.asarray(st.jump_cnt)[lane])
 
         # JUMPDESTs retired on device extend the per-state jumpdest trace,
         # so BoundedLoopsStrategy bounds device-explored loops too. The

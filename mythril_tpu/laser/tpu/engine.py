@@ -969,6 +969,11 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             )
         ),
         jd_cnt=st.jd_cnt + (committed & (op == 0x5B)),
+        # the host increments mstate.depth once per JUMP/JUMPI evaluated
+        # (instructions.py jump_/jumpi_), NOT per instruction — mirror
+        # that unit so --max-depth means the same thing on either path
+        jump_cnt=st.jump_cnt
+        + (committed & ((op == 0x56) | (op == 0x57))).astype(I32),
         stack_sym=merge(stack_sym_after, st.stack_sym),
         # tape planes commit unconditionally: rows were written by masked
         # per-lane scatters, and a non-committing lane reverts via tape_len

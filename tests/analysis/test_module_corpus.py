@@ -80,8 +80,18 @@ def test_corpus_host(name):
     assert not (must_not & swcs), f"{name}: spurious {must_not & swcs}"
 
 
-@pytest.mark.parametrize("name", ["origin.sol.o", "suicide.sol.o"])
+@pytest.mark.parametrize(
+    "name",
+    [
+        "origin.sol.o",
+        "suicide.sol.o",
+        # multi-tx arithmetic through device-retired ADD/SUB/JUMPI — pins
+        # the depth-unit fix (device jumps, not instructions, count
+        # toward --max-depth) and the batch-aware integer replay
+        "overflow.sol.o",
+    ],
+)
 def test_corpus_device_parity(name):
     host = analyze(name)
-    device = analyze(name, strategy="tpu-batch", timeout=300)
+    device = analyze(name, strategy="tpu-batch", timeout=400)
     assert host == device, f"{name}: host {host} != device {device}"
