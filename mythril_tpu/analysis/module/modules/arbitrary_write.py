@@ -18,6 +18,9 @@ class ArbitraryStorage(ProbeModule):
     swc_id = WRITE_TO_ARBITRARY_STORAGE
     description = "Search for any writes to an arbitrary storage slot"
     pre_hooks = ["SSTORE"]
+    # the probe only reads the written slot; the bridge re-fires it per
+    # recorded device SSTORE event with the lifted key term
+    tape_replay_hooks = frozenset({"SSTORE"})
 
     deferred = True
     title = "The caller can write to arbitrary storage locations."

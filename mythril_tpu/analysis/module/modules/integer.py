@@ -146,7 +146,7 @@ class IntegerArithmetics(DetectionModule):
     # approximation: the device tape CSE-merges identical (op, operands)
     # nodes per lane, so arithmetic the host would tag at several sites
     # replays once, at the first site (compilers CSE such code anyway)
-    tape_replay_hooks = frozenset({"ADD", "MUL", "EXP", "SUB", "JUMPI"})
+    tape_replay_hooks = frozenset({"ADD", "MUL", "EXP", "SUB", "JUMPI", "SSTORE"})
 
     def __init__(self) -> None:
         super().__init__()

@@ -30,6 +30,10 @@ ATTACKER_PROBE_ADDRESS = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
 class ReentrancyWindow(StateAnnotation):
     """Open from a gas-forwarding external call until transaction end."""
 
+    # the window must observe every SSTORE/SLOAD/CREATE that follows the
+    # call; states carrying one stay on the host path (PackError)
+    pack_to_device = False
+
     def __init__(self, call_state, attacker_controlled: bool) -> None:
         self.call_state = call_state
         self.attacker_controlled = attacker_controlled
@@ -68,6 +72,10 @@ class StateChangeAfterCall(ProbeModule):
         "of an external call"
     )
     pre_hooks = list(CALL_OPS) + list(STATE_ACCESS_OPS)
+    # safe to retire on device: without an open ReentrancyWindow the
+    # SSTORE/SLOAD probe is vacuous, and window-carrying states never
+    # pack (ReentrancyWindow.pack_to_device); CALL/CREATE always trap
+    tape_replay_hooks = frozenset({"SSTORE", "SLOAD"})
 
     deferred = True
     severity = "Low"

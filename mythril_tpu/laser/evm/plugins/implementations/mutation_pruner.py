@@ -45,6 +45,10 @@ class MutationPruner(LaserPlugin):
         def mark_mutation(global_state: GlobalState):
             global_state.annotate(MutationAnnotation())
 
+        # annotation-only and order-independent: the device bridge may
+        # retire the opcode and re-fire this hook at lift time
+        mark_mutation.tape_replay_safe = True
+
         for opcode in MUTATING_OPS:
             symbolic_vm.pre_hook(opcode)(mark_mutation)
 

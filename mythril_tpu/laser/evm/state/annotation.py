@@ -21,6 +21,15 @@ class StateAnnotation:
         """Propagate into the global states of inter-contract calls."""
         return False
 
+    @property
+    def pack_to_device(self) -> bool:
+        """Whether a state carrying this annotation may enter the batched
+        device pipeline. Annotations that need per-instruction host hooks
+        to stay exact (e.g. an open reentrancy window observing every
+        state access) return False; the bridge then keeps the state on
+        the host path, where hooks fire with full fidelity."""
+        return True
+
 
 class NoCopyAnnotation(StateAnnotation):
     """Shared (never copied) across forks — for expensive immutable

@@ -119,10 +119,21 @@ def find_tpu_strategy(strategy) -> Optional[TpuBatchStrategy]:
     return None
 
 
+# opcodes whose skipped raw pre-hooks get re-fired at synthesized sites
+# by the bridge (currently only SSTORE has an event ring); a plugin's
+# tape_replay_safe marker is only honored where such a channel exists —
+# accepting it elsewhere would silently drop the hook
+_RAW_REPLAY_OPS = frozenset({"SSTORE"})
+
+
 def _replayable_pre_hook(name: str, hooks) -> bool:
-    """True when every pre-hook on ``name`` belongs to a detection module
-    that can replay it over the lifted term tape (batch-aware mode)."""
+    """True when every pre-hook on ``name`` is batch-aware: either a
+    bound method of a detection module declaring the opcode in
+    tape_replay_hooks, or — on opcodes with a raw-hook replay channel —
+    a plugin hook self-marked tape_replay_safe."""
     for hook in hooks:
+        if name in _RAW_REPLAY_OPS and getattr(hook, "tape_replay_safe", False):
+            continue
         owner = getattr(hook, "__self__", None)
         if owner is None or name not in getattr(
             owner, "tape_replay_hooks", frozenset()
@@ -185,7 +196,18 @@ def tape_replayers_for(laser) -> dict:
         if not _replayable_pre_hook(name, hooks) or laser.post_hooks.get(name):
             continue
         for hook in hooks:
-            out.setdefault(mapping[name], []).append((hook.__self__, name))
+            owner = getattr(hook, "__self__", None)
+            if owner is not None:
+                out.setdefault(mapping[name], []).append((owner, name))
+    # SSTORE sites replay the RAW skipped pre-hooks (modules and marked
+    # plugin hooks alike) over the recorded event ring
+    sstore_hooks = laser.pre_hooks.get("SSTORE", [])
+    if (
+        sstore_hooks
+        and _replayable_pre_hook("SSTORE", sstore_hooks)
+        and not laser.post_hooks.get("SSTORE")
+    ):
+        out["SSTORE"] = list(sstore_hooks)
     return out
 
 

@@ -83,6 +83,11 @@ class Env(NamedTuple):
 # the last JD_RING jumpdests a lane visited (suffix cycles up to ~JD_RING/2)
 JD_RING = 64
 
+# SSTORE event capacity per lane: the bridge re-fires the skipped SSTORE
+# pre-hooks per recorded event at lift time; a lane with more SSTOREs
+# than this freeze-traps at the overflowing SSTORE (exact events matter)
+SS_RING = 16
+
 
 class StateBatch(NamedTuple):
     alive: jnp.ndarray  # bool[L] lane holds a state
@@ -113,6 +118,10 @@ class StateBatch(NamedTuple):
     jd_ring: jnp.ndarray  # i32[L, JD_RING] last JUMPDEST byte-pcs (loop bounds)
     jd_cnt: jnp.ndarray  # i32[L] total JUMPDESTs retired
     jump_cnt: jnp.ndarray  # i32[L] JUMP/JUMPI retired (the host's depth unit)
+    ss_pc: jnp.ndarray  # i32[L, SS_RING] byte pc of each device-retired SSTORE
+    ss_key: jnp.ndarray  # i32[L, SS_RING] key tape tag (0 = concrete key)
+    ss_val: jnp.ndarray  # i32[L, SS_RING] value tape tag (0 = concrete value)
+    ss_cnt: jnp.ndarray  # i32[L] SSTOREs retired on device
     # ---- symbolic layer (laser/tpu/symtape.py). Tags are 1-based tape
     # ids; 0 = concrete (the word/byte planes are authoritative).
     stack_sym: jnp.ndarray  # i32[L, S]
@@ -184,6 +193,10 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "jd_ring": ((L, JD_RING), np.int32),
         "jd_cnt": ((L,), np.int32),
         "jump_cnt": ((L,), np.int32),
+        "ss_pc": ((L, SS_RING), np.int32),
+        "ss_key": ((L, SS_RING), np.int32),
+        "ss_val": ((L, SS_RING), np.int32),
+        "ss_cnt": ((L,), np.int32),
         "stack_sym": ((L, S), np.int32),
         "tape_op": ((L, T), np.int32),
         "tape_a": ((L, T), np.int32),
@@ -359,6 +372,10 @@ def _fill_lane(
     np_batch["jd_ring"][lane] = 0
     np_batch["jd_cnt"][lane] = 0
     np_batch["jump_cnt"][lane] = 0
+    np_batch["ss_pc"][lane] = 0
+    np_batch["ss_key"][lane] = 0
+    np_batch["ss_val"][lane] = 0
+    np_batch["ss_cnt"][lane] = 0
     # symbolic layer resets
     for f in (
         "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1",

@@ -213,6 +213,11 @@ class DeviceBridge:
 
     def pack_into(self, np_batch: dict, lane: int, state: GlobalState) -> None:
         """Pack one host GlobalState into one lane of a numpy batch."""
+        for annotation in state.annotations:
+            if not getattr(annotation, "pack_to_device", True):
+                raise PackError(
+                    f"annotation requires host hooks: {type(annotation).__name__}"
+                )
         env = state.environment
         mstate = state.mstate
         account = env.active_account
@@ -871,7 +876,58 @@ class DeviceBridge:
             gs.world_state.constraints.append(cond)
 
         self._replay_jumpi_sites(gs, st, lane, values)
+        self._replay_sstore_sites(gs, st, lane, values)
         return gs
+
+    def _replay_sstore_sites(self, gs, st, lane, values) -> None:
+        """Re-fire the skipped SSTORE pre-hooks for every SSTORE the
+        device retired on this lane (recorded in the ss_* event ring).
+
+        Same mutate-and-restore site synthesis as the JUMPI replay: pc at
+        the SSTORE, ``[value, key]`` on top of the stack. Concrete keys
+        and values appear as zero-valued words — every replayed hook is
+        annotation- or constraint-based on SYMBOLIC operands (a concrete
+        key makes arbitrary-write's sentinel constraint unsatisfiable and
+        a concrete value cannot carry hazard annotations), so the
+        placeholders are behavior-preserving."""
+        hooks = self.tape_replayers.get("SSTORE")
+        if not hooks:
+            return
+        count = int(np.asarray(st.ss_cnt)[lane])
+        if count == 0:
+            return
+        ss_pc = np.asarray(st.ss_pc)[lane]
+        ss_key = np.asarray(st.ss_key)[lane]
+        ss_val = np.asarray(st.ss_val)[lane]
+        instr_list = gs.environment.code.instruction_list
+        saved_pc, saved_stack = gs.mstate.pc, gs.mstate.stack
+        zero = symbol_factory.BitVecVal(0, 256)
+
+        def term(tag):
+            if tag > 0 and values[tag - 1] is not None:
+                return values[tag - 1]
+            return zero
+
+        try:
+            for j in range(min(count, ss_pc.shape[0])):
+                pc_index = evm_util.get_instruction_index(
+                    instr_list, int(ss_pc[j])
+                )
+                if pc_index is None:
+                    continue
+                gs.mstate.pc = pc_index
+                gs.mstate.stack = MachineStack(
+                    [term(int(ss_val[j])), term(int(ss_key[j]))]
+                )
+                with forced_hook_phase(prehook=True):
+                    for hook in hooks:
+                        try:
+                            hook(gs)
+                        except Exception as e:  # pragma: no cover
+                            log.warning("SSTORE replay failed: %s", e)
+        finally:
+            gs.mstate.pc = saved_pc
+            gs.mstate.stack = saved_stack
 
     def _replay_jumpi_sites(self, gs, st, lane, values) -> None:
         """Run JUMPI pre-hooks of batch-aware modules for every branch

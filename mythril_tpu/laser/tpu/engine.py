@@ -582,6 +582,25 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         st.storage_used[lane, store_slot] | do_store
     )
 
+    # SSTORE event ring: the bridge re-fires the skipped SSTORE pre-hooks
+    # per recorded event at lift time; overflow freeze-traps (exact events
+    # matter to the replayed detection hooks)
+    SSR = st.ss_pc.shape[1]
+    sstore_event = do_store & is_sstore
+    ss_full_trap = is_sstore & ~storage_trap & ~sym_key_trap & (st.ss_cnt >= SSR)
+    sstore_event = sstore_event & ~ss_full_trap
+    ss_widx = jnp.clip(st.ss_cnt, 0, SSR - 1)
+
+    def ss_put(plane, val):
+        return plane.at[lane, ss_widx].set(
+            jnp.where(sstore_event, val, plane[lane, ss_widx])
+        )
+
+    new_ss_pc = ss_put(st.ss_pc, st.pc)
+    new_ss_key = ss_put(st.ss_key, write_key_sym)
+    new_ss_val = ss_put(st.ss_val, jnp.where(has_b, sym_b, 0))
+    new_ss_cnt = st.ss_cnt + sstore_event.astype(I32)
+
     # ------------------------------------------------------------------
     # SHA3 (memory slice -> keccak, under cond)
     sha_trap = is_sha3 & ~has_a & ~has_b & (b32 > SHA_CAP)
@@ -760,6 +779,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         | ms_ins_trap
         | mstore_conc_trap
         | mstore8_ovl_trap
+        | ss_full_trap
         | copy_ovl_trap
         | sha_sym_trap
         | alloc_trap
@@ -974,6 +994,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         # that unit so --max-depth means the same thing on either path
         jump_cnt=st.jump_cnt
         + (committed & ((op == 0x56) | (op == 0x57))).astype(I32),
+        ss_pc=merge(new_ss_pc, st.ss_pc),
+        ss_key=merge(new_ss_key, st.ss_key),
+        ss_val=merge(new_ss_val, st.ss_val),
+        ss_cnt=merge(new_ss_cnt, st.ss_cnt),
         stack_sym=merge(stack_sym_after, st.stack_sym),
         # tape planes commit unconditionally: rows were written by masked
         # per-lane scatters, and a non-committing lane reverts via tape_len

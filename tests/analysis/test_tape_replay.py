@@ -66,7 +66,9 @@ def test_arithmetic_not_in_trap_set_when_integer_only_hooker():
     hooked = backend.host_op_bytes(sym.laser)
     assert 0x01 not in hooked  # ADD retires on device
     assert 0x57 not in hooked  # JUMPI retires on device (all hookers replay)
-    assert 0x55 in hooked  # SSTORE still traps (non-replay hookers)
+    assert 0x55 not in hooked  # SSTORE retires; events replay from the ring
+    assert 0x54 not in hooked  # SLOAD retires (sole hooker is window-gated)
+    assert 0xF1 in hooked  # CALL always traps
 
 
 ORIGIN_BRANCH_SRC = """
@@ -123,3 +125,65 @@ def test_device_retired_jumpi_reports_timestamp_dependence():
     )
     assert "116" in {i.swc_id for i in issues}
     assert strategy.device_steps_retired > 0
+
+
+ARBITRARY_WRITE_SRC = "PUSH1 0x01\nPUSH1 0x00\nCALLDATALOAD\nSSTORE\nSTOP"
+
+STATE_CHANGE_SRC = """
+PUSH1 0x00
+PUSH1 0x00
+PUSH1 0x00
+PUSH1 0x00
+PUSH1 0x00
+PUSH1 0x00
+CALLDATALOAD
+PUSH3 0xffffff
+CALL
+POP
+PUSH1 0x01
+PUSH1 0x00
+SSTORE
+STOP
+"""
+
+
+def test_sstore_replay_parity_arbitrary_write():
+    # caller-controlled raw key: the device traps on the non-keccak
+    # symbolic key, so the host hook fires — parity must hold
+    host, _s, _ = analyze(ARBITRARY_WRITE_SRC, ["ArbitraryStorage"], strategy="bfs")
+    dev, _s, _ = analyze(ARBITRARY_WRITE_SRC, ["ArbitraryStorage"])
+    assert {i.swc_id for i in host} == {i.swc_id for i in dev}
+    assert "124" in {i.swc_id for i in dev}
+
+
+def test_sstore_after_call_still_reports_on_device():
+    # the post-CALL state carries an open ReentrancyWindow, which refuses
+    # device packing — the SSTORE runs on host with full hooks
+    issues, _sym, _strategy = analyze(STATE_CHANGE_SRC, ["StateChangeAfterCall"])
+    assert "107" in {i.swc_id for i in issues}
+
+
+MAPPING_WRITE_SRC = """
+CALLER
+PUSH1 0x00
+MSTORE
+PUSH1 0x20
+PUSH1 0x00
+SHA3
+PUSH1 0x00
+CALLDATALOAD
+SWAP1
+SSTORE
+STOP
+"""
+
+
+def test_sstore_ring_replay_with_keccak_key():
+    # a keccak-rooted symbolic slot RETIRES on device, so the event ring
+    # must carry the key tag and the replay must lift it for the
+    # arbitrary-write probe — host/device parity on both modules
+    for modules in (["ArbitraryStorage"], ["IntegerArithmetics"]):
+        host, _s, _ = analyze(MAPPING_WRITE_SRC, modules, strategy="bfs")
+        dev, _s, strategy = analyze(MAPPING_WRITE_SRC, modules)
+        assert {i.swc_id for i in host} == {i.swc_id for i in dev}, modules
+        assert strategy.device_steps_retired > 0
