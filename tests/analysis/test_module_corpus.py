@@ -85,11 +85,16 @@ def test_corpus_host(name):
     [
         "origin.sol.o",
         "suicide.sol.o",
-        # multi-tx arithmetic through device-retired ADD/SUB/JUMPI — pins
-        # the depth-unit fix (device jumps, not instructions, count
-        # toward --max-depth) and the batch-aware integer replay
+        # multi-tx arithmetic through device-retired ADD/SUB/JUMPI/SSTORE
+        # — pins the depth-unit fix (device jumps, not instructions,
+        # count toward --max-depth) and the batch-aware hook replay
         "overflow.sol.o",
-    ],
+    ]
+    + (
+        ["underflow.sol.o", "exceptions.sol.o", "metacoin.sol.o", "ether_send.sol.o"]
+        if FULL
+        else []
+    ),
 )
 def test_corpus_device_parity(name):
     host = analyze(name)
