@@ -28,7 +28,6 @@ the host path — the loop degrades gracefully to pure host execution.
 
 import logging
 import time
-from datetime import datetime, timedelta
 from typing import List, Optional
 
 import numpy as np
@@ -74,7 +73,10 @@ _NAME_TO_BYTE = {spec.name: byte for byte, spec in OPCODES.items()}
 # module-level default so tests/CLI can swap in a differently-sized batch
 # before SymExecWrapper constructs the strategy
 DEFAULT_BATCH_CFG = BatchConfig(
-    lanes=256,
+    # 512 lanes: device forking fills lanes well beyond the staged
+    # frontier now that whole transaction bodies retire on device
+    # (+50% integrated throughput over 256 on the bench contract)
+    lanes=512,
     stack_slots=32,
     memory_bytes=1024,
     calldata_bytes=256,
@@ -290,7 +292,13 @@ def _warn_mesh_stats_once() -> None:
         )
 
 
-def _run_device(cb, st, cfg, want_stats=False):
+# steps per deadline check: a full DEVICE_STEP_BUDGET round can take
+# minutes on a slow backend, silently overshooting --execution-timeout;
+# slicing bounds the overshoot to one slice's wall time
+DEVICE_SLICE_STEPS = 512
+
+
+def _run_device(cb, st, cfg, want_stats=False, deadline=None):
     """Run the packed batch to quiescence: single-device fast path, or —
     with more than one visible device — lane-sharded SPMD over a mesh with
     occupancy-gated all-to-all rebalancing (SURVEY §5 distributed backend;
@@ -298,7 +306,8 @@ def _run_device(cb, st, cfg, want_stats=False):
 
     Returns ``(state, op_hist_or_None)``; the u32[256] retired-opcode
     histogram feeds the instruction profiler and is only produced on the
-    single-device path (``want_stats``)."""
+    single-device path (``want_stats``). ``deadline`` (time.time value)
+    bounds the round for --execution-timeout honesty."""
     import jax
 
     from mythril_tpu.laser.tpu import mesh as mesh_lib
@@ -310,11 +319,25 @@ def _run_device(cb, st, cfg, want_stats=False):
         not _use_mesh(n_shards, devices[0].platform)
         or cfg.lanes % n_shards != 0
     ):
-        if want_stats:
-            return run_with_stats(
-                cb, default_env(), st, max_steps=DEVICE_STEP_BUDGET
-            )
-        return run(cb, default_env(), st, max_steps=DEVICE_STEP_BUDGET), None
+        import jax.numpy as jnp
+
+        hist = None
+        for _ in range(0, DEVICE_STEP_BUDGET, DEVICE_SLICE_STEPS):
+            if want_stats:
+                st, slice_hist = run_with_stats(
+                    cb, default_env(), st, max_steps=DEVICE_SLICE_STEPS
+                )
+                hist = slice_hist if hist is None else hist + slice_hist
+            else:
+                st = run(cb, default_env(), st, max_steps=DEVICE_SLICE_STEPS)
+            # the quiescence fetch blocks on the slice just dispatched, so
+            # the deadline check AFTER it has absorbed the slice's device
+            # time — overshoot is bounded by one slice
+            if not bool(jnp.any(st.alive & (st.status == _RUNNING))):
+                break
+            if deadline is not None and time.time() > deadline:
+                break
+        return st, hist
     if want_stats:
         _warn_mesh_stats_once()
 
@@ -334,6 +357,8 @@ def _run_device(cb, st, cfg, want_stats=False):
         )
         steps_done += MESH_STEPS_PER_ROUND
         if not bool(np.asarray(st.alive & (st.status == _RUNNING)).any()):
+            break
+        if deadline is not None and time.time() > deadline:
             break
     return st, None
 
@@ -437,17 +462,18 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
     replayers = tape_replayers_for(laser)
     seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
     final_states: List[GlobalState] = []
+    budget_deadline = (
+        laser.time.timestamp() + laser.execution_timeout
+        if laser.execution_timeout
+        else None
+    )
     if laser.iprof is not None:
         # profiled runs use the histogram specialization of the run loop;
         # compile it before the first real round
         warmup_device(cfg, want_stats=True)
 
     while laser.work_list:
-        if (
-            laser.execution_timeout
-            and laser.time + timedelta(seconds=laser.execution_timeout)
-            <= datetime.now()
-        ):
+        if budget_deadline is not None and time.time() >= budget_deadline:
             log.debug("Hit execution timeout in tpu-batch loop, returning.")
             # keep the in-flight frontier: the host loop's timeout path
             # returns the currently selected state too
@@ -510,7 +536,11 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         cb, st = bridge.finish()
         round_start = time.time()
         out, op_hist = _run_device(
-            cb, st, cfg, want_stats=laser.iprof is not None
+            cb,
+            st,
+            cfg,
+            want_stats=laser.iprof is not None,
+            deadline=budget_deadline,
         )
         # one download: everything below (step counters, coverage merge,
         # per-lane unpack/lift) reads the host view for free
