@@ -187,3 +187,26 @@ def test_sstore_ring_replay_with_keccak_key():
         dev, _s, strategy = analyze(MAPPING_WRITE_SRC, modules)
         assert {i.swc_id for i in host} == {i.swc_id for i in dev}, modules
         assert strategy.device_steps_retired > 0
+
+
+def test_sstore_ring_overflow_degrades_to_host():
+    # more SSTOREs in one segment than the event ring holds: the lane
+    # freeze-traps at the overflowing SSTORE and the host executes the
+    # rest with real hooks — detection must be unaffected
+    writes = "\n".join(
+        f"PUSH1 0x0{i % 10}\nPUSH1 0x{i:02x}\nSSTORE" for i in range(20)
+    )
+    src = f"""
+PUSH1 0x00
+CALLDATALOAD
+PUSH1 0x20
+CALLDATALOAD
+ADD
+PUSH1 0x00
+SSTORE
+{writes}
+STOP
+"""
+    issues, _sym, strategy = analyze(src, ["IntegerArithmetics"])
+    assert "101" in {i.swc_id for i in issues}
+    assert strategy.device_steps_retired > 0
