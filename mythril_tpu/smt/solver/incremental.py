@@ -229,10 +229,24 @@ class IncrementalCore:
     ) -> int:
         """Solve under assumptions, repairing violated Ackermann
         congruence among the query-relevant entries until the model is
-        consistent (or rounds run out -> UNKNOWN)."""
+        consistent (or rounds run out -> UNKNOWN).
+
+        ``timeout_ms`` bounds the WHOLE loop, not each round: a repair
+        loop of N rounds each granted the full budget overshot
+        feasibility checks ~5x (profiled: 100ms budgets averaging 540ms
+        per is_possible on multiplier-heavy constraints)."""
+        deadline = (
+            time.monotonic() + timeout_ms / 1000.0 if timeout_ms else None
+        )
         for _ in range(max_repair_rounds):
+            round_ms = timeout_ms
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return pysat.UNKNOWN
+                round_ms = max(1, int(remaining * 1000))
             code = self.solve(
-                lits, timeout_ms=timeout_ms, conflict_budget=conflict_budget
+                lits, timeout_ms=round_ms, conflict_budget=conflict_budget
             )
             if code != pysat.SAT:
                 return code
