@@ -22,6 +22,7 @@ import json
 import logging
 import os
 import sys
+import traceback
 from typing import Callable, Dict, List, Optional, Tuple
 
 from mythril_tpu import __version__
@@ -393,12 +394,42 @@ def main(argv: Optional[List[str]] = None) -> None:
         sys.exit(2)
     _set_verbosity(args.verbosity)
     outform = getattr(args, "outform", "text")
+    exit_code = 0
     try:
         COMMANDS[command][2](args)
-    except CriticalError as e:
-        exit_with_error(outform, str(e))
-    except KeyboardInterrupt:
-        exit_with_error(outform, "Analysis was interrupted")
+    except (CriticalError, KeyboardInterrupt) as e:
+        msg = str(e) if isinstance(e, CriticalError) else "Analysis was interrupted"
+        try:
+            exit_with_error(outform, msg)
+        except SystemExit as se:
+            exit_code = se.code if isinstance(se.code, int) else 1
+    except SystemExit as e:
+        exit_code = e.code if isinstance(e.code, int) else (1 if e.code else 0)
+    except BaseException:
+        # traceback must print BEFORE the hard-exit check below — a
+        # finally: os._exit would swallow it
+        traceback.print_exc()
+        exit_code = 1
+    _hard_exit_if_compiling(exit_code)
+    if exit_code:
+        sys.exit(exit_code)
+
+
+def _hard_exit_if_compiling(code: int) -> None:
+    """Skip interpreter finalization while a device-kernel compile is in
+    flight on a background thread (tpu-batch warmup, laser/tpu/backend).
+
+    The analysis deliberately does not wait for a slow XLA compile — or
+    a wedged accelerator tunnel — so at exit time the warmup thread can
+    still be tracing/compiling; CPython teardown while that native work
+    runs intermittently corrupts the heap (observed: glibc "double free
+    or corruption" after results were already printed). Results are out,
+    so a hard exit loses nothing."""
+    backend = sys.modules.get("mythril_tpu.laser.tpu.backend")
+    if backend is not None and backend.warmup_pending():
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(code)
 
 
 if __name__ == "__main__":

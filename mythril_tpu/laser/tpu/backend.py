@@ -27,6 +27,7 @@ the host path — the loop degrades gracefully to pure host execution.
 """
 
 import logging
+import threading
 import time
 from typing import List, Optional
 
@@ -101,10 +102,13 @@ class TpuBatchStrategy(BasicSearchStrategy):
         self.batch_cfg = batch_cfg or DEFAULT_BATCH_CFG
         self.device_rounds = 0
         self.device_steps_retired = 0
-        # compile the device kernels NOW, before sym_exec starts the
-        # execution clock: a cold XLA compile inside the timed loop would
-        # be billed against --execution-timeout and can truncate analyses
-        warmup_device(self.batch_cfg)
+        # start compiling the device kernels NOW on a background thread:
+        # the creation transaction and the first host rounds overlap the
+        # XLA compile, and exec_batch switches to device rounds the
+        # moment the kernels land. Blocking here instead would stall the
+        # whole CLI behind a compile that can take minutes on a slow
+        # machine — or forever on a wedged accelerator tunnel.
+        warmup_device_async(self.batch_cfg)
 
     def get_strategic_global_state(self) -> GlobalState:
         return self.work_list.pop(0)
@@ -220,7 +224,77 @@ MIN_DEVICE_SOLVE_BATCH = 4
 # device-phase step budget per exec_batch round
 DEVICE_STEP_BUDGET = 4096
 
-_warmed_cfgs = set()
+# warmup bookkeeping: an Event per (cfg, want_stats) marks a compile
+# attempt in flight; membership in _warmup_done marks SUCCESS. A compile
+# is attempted exactly once per process — a failed (or hung: wedged
+# accelerator tunnel) warmup leaves the device path permanently cold and
+# the analysis completes on the host loop instead of blocking.
+_warmup_lock = threading.Lock()
+_warmup_events: dict = {}
+_warmup_done = set()
+
+# The product path compiles on a background thread and lets host rounds
+# overlap (see warmup_device_async). The test suite flips this to False
+# (tests/conftest.py): tests assert device participation deterministically,
+# so the strategy constructor must block until the kernels are ready.
+WARMUP_ASYNC = True
+
+
+def device_ready(cfg: BatchConfig, want_stats: bool = False) -> bool:
+    """True once the kernels for this config compiled successfully."""
+    return (cfg, want_stats) in _warmup_done
+
+
+def _warmup_attempted(cfg: BatchConfig, want_stats: bool = False) -> bool:
+    """True once a compile attempt for this config has CONCLUDED (either
+    way) — distinguishes 'warmup failed' from 'still compiling'."""
+    event = _warmup_events.get((cfg, want_stats))
+    return event is not None and event.is_set()
+
+
+def warmup_pending() -> bool:
+    """True while any warmup compile is still in flight on a background
+    thread. The CLI checks this at exit: CPython finalization under a
+    live native compile intermittently corrupts the heap, so callers
+    that are done should hard-exit instead of tearing down."""
+    with _warmup_lock:
+        return any(not event.is_set() for event in _warmup_events.values())
+
+
+def _claim_warmup(key):
+    """Atomically register a compile attempt. Returns (event, owner):
+    the caller owns the compile iff no attempt existed for this key."""
+    with _warmup_lock:
+        event = _warmup_events.get(key)
+        if event is not None:
+            return event, False
+        event = _warmup_events[key] = threading.Event()
+        return event, True
+
+
+def warmup_device_async(cfg: BatchConfig, want_stats: bool = False) -> None:
+    """Kick the compile off on a daemon thread and return immediately.
+
+    exec_batch keeps running host rounds until device_ready flips, so a
+    slow XLA compile (or a wedged TPU tunnel that never answers) costs
+    the analysis nothing but the device speedup it would have had: the
+    reference CLI contract — analysis bounded by --execution-timeout —
+    holds even when the accelerator is unreachable.
+
+    With WARMUP_ASYNC off (the test suite) this compiles synchronously
+    instead, so both production call sites dispatch through here."""
+    if not WARMUP_ASYNC:
+        warmup_device(cfg, want_stats)
+        return
+    key = (cfg, want_stats)
+    event, owner = _claim_warmup(key)
+    if owner:
+        threading.Thread(
+            target=_do_warmup,
+            args=(key, event),
+            name="tpu-warmup",
+            daemon=True,
+        ).start()
 
 
 def warmup_device(cfg: BatchConfig, want_stats: bool = False) -> None:
@@ -229,10 +303,19 @@ def warmup_device(cfg: BatchConfig, want_stats: bool = False) -> None:
     no-op but XLA compiles (and the persistent compile cache fills).
     Only the jit specialization the hot loop will use is compiled:
     ``want_stats`` selects the opcode-histogram variant (exec_batch
-    warms it on demand when the profiler is enabled)."""
-    if (cfg, want_stats) in _warmed_cfgs:
+    warms it on demand when the profiler is enabled). Synchronous: on
+    return the config is either ready (device_ready true) or has failed
+    for the life of the process."""
+    key = (cfg, want_stats)
+    event, owner = _claim_warmup(key)
+    if not owner:
+        event.wait()
         return
-    _warmed_cfgs.add((cfg, want_stats))
+    _do_warmup(key, event)
+
+
+def _do_warmup(key, event) -> None:
+    cfg, want_stats = key
     try:
         from mythril_tpu.laser.tpu.batch import batch_shapes, make_code_bank
 
@@ -255,8 +338,11 @@ def warmup_device(cfg: BatchConfig, want_stats: bool = False) -> None:
 
         warm_formula = [_terms.bool_eq(_terms.bv_var("!warmup", 8), _terms.bv_const(1, 8))]
         solver_jax.check_batch([warm_formula] * MIN_DEVICE_SOLVE_BATCH)
+        _warmup_done.add(key)
     except Exception as e:  # pragma: no cover - warmup is best-effort
-        log.warning("device warmup failed (continuing cold): %s", e)
+        log.warning("device warmup failed (analysis stays on host): %s", e)
+    finally:
+        event.set()
 
 
 # lockstep steps between rebalance opportunities on a multi-device mesh
@@ -370,11 +456,16 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
     incremental CDCL pick up only the instances the device left open.
 
     Replaces the reference's one-Z3-call-per-forked-state pattern
-    (mythril/laser/ethereum/svm.py:254, state/constraints.py:41)."""
+    (mythril/laser/ethereum/svm.py:254, state/constraints.py:41).
+
+    The device dispatch only engages after some warmup completed (the
+    solver kernels compile alongside the step kernel): before that, a
+    call here would pay the solver compile inline — or hang on a dead
+    tunnel — while the host CDCL answers lazily anyway."""
     undecided = [
         s for s in states if s.world_state.constraints._is_possible is None
     ]
-    if len(undecided) >= MIN_DEVICE_SOLVE_BATCH:
+    if _warmup_done and len(undecided) >= MIN_DEVICE_SOLVE_BATCH:
         sets = [
             [c.raw for c in s.world_state.constraints] for s in undecided
         ]
@@ -467,10 +558,11 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         if laser.execution_timeout
         else None
     )
-    if laser.iprof is not None:
+    want_stats = laser.iprof is not None
+    if want_stats:
         # profiled runs use the histogram specialization of the run loop;
-        # compile it before the first real round
-        warmup_device(cfg, want_stats=True)
+        # start compiling it alongside the plain variant
+        warmup_device_async(cfg, want_stats=True)
 
     while laser.work_list:
         if budget_deadline is not None and time.time() >= budget_deadline:
@@ -511,7 +603,13 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         if not survivors:
             continue
 
-        # ---------------- phase B: batched device rounds
+        # ---------------- phase B: batched device rounds.
+        # Until the background warmup lands the compiled kernels, phase A
+        # keeps making host progress — none of it wasted — and the device
+        # joins mid-analysis the moment it is ready.
+        if not device_ready(cfg, want_stats):
+            laser.work_list.extend(survivors)
+            continue
         to_pack = survivors[:seed_cap]
         overflow = survivors[seed_cap:]
         laser.work_list.extend(overflow)
@@ -539,7 +637,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             cb,
             st,
             cfg,
-            want_stats=laser.iprof is not None,
+            want_stats=want_stats,
             deadline=budget_deadline,
         )
         # one download: everything below (step counters, coverage merge,
@@ -600,4 +698,15 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         )
         # device-born forks add to the explored-state count
         laser.total_states += max(0, int(alive.sum()) - len(packed_states))
+    if strategy.device_rounds == 0 and not device_ready(cfg, want_stats):
+        if _warmup_attempted(cfg, want_stats):
+            log.info(
+                "device warmup failed earlier (see warning above); the "
+                "whole analysis ran on the host path"
+            )
+        else:
+            log.info(
+                "analysis drained before the device kernels finished "
+                "compiling; all execution stayed on the host path"
+            )
     return final_states if track_gas else None

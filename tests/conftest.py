@@ -54,3 +54,10 @@ from mythril_tpu.laser.tpu import solver_jax as _solver_jax  # noqa: E402
 
 _solver_jax.MAX_VARS = 512
 _solver_jax.MAX_CLAUSES = 2048
+
+# Production warms up asynchronously (host rounds overlap XLA compile);
+# tests assert device participation deterministically, so the strategy
+# constructor must block until the kernels are compiled.
+from mythril_tpu.laser.tpu import backend as _backend  # noqa: E402
+
+_backend.WARMUP_ASYNC = False

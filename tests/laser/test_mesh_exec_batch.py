@@ -31,7 +31,8 @@ def mesh_on(monkeypatch):
     monkeypatch.setattr(backend, "DEFAULT_BATCH_CFG", MESH_CFG)
     # the sharded kernel is a different executable than the single-device
     # one: force a fresh warmup for this config under mesh mode
-    backend._warmed_cfgs.discard(MESH_CFG)
+    backend._warmup_events.pop((MESH_CFG, False), None)
+    backend._warmup_done.discard((MESH_CFG, False))
 
 
 def make_creation(runtime_hex: str) -> str:
