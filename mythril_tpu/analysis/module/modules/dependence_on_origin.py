@@ -6,6 +6,7 @@ carries the tag is an issue."""
 
 from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import TX_ORIGIN_USAGE
+from mythril_tpu.smt import BitVec
 
 
 class OriginTaint:
@@ -20,8 +21,10 @@ class TxOrigin(ProbeModule):
     post_hooks = ["ORIGIN"]
     # the JUMPI probe only reads the condition's taint annotations, which
     # survive pack/lift; the bridge replays it at branch sites the device
-    # retired (ORIGIN itself stays host-hooked and taints at the source)
+    # retired. ORIGIN retires too: the post-hook taint replays over the
+    # lifted leaf value (replay_tape_value below).
     tape_replay_hooks = frozenset({"JUMPI"})
+    tape_replay_post_hooks = frozenset({"ORIGIN"})
 
     title = "Dependence on tx.origin"
     severity = "Low"
@@ -41,6 +44,13 @@ class TxOrigin(ProbeModule):
         condition = state.mstate.stack[-2]
         if any(isinstance(a, OriginTaint) for a in condition.annotations):
             yield Finding()
+
+    def replay_tape_value(self, origin, opcode: str, value, arg):
+        """Batch-aware ORIGIN post-hook: same taint, applied to a fresh
+        wrapper so the shared seed term stays clean across lanes."""
+        return BitVec(
+            value.raw, annotations=set(value.annotations) | {OriginTaint()}
+        )
 
 
 detector = TxOrigin()

@@ -13,7 +13,7 @@ from mythril_tpu.analysis.module.probe import Finding, ProbeModule
 from mythril_tpu.analysis.swc_data import TIMESTAMP_DEPENDENCE, WEAK_RANDOMNESS
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.evm.state.annotation import StateAnnotation
-from mythril_tpu.smt import ULT, symbol_factory
+from mythril_tpu.smt import ULT, BitVec, symbol_factory
 
 BLOCK_VARIABLE_OPS = ("COINBASE", "GASLIMIT", "TIMESTAMP", "NUMBER")
 
@@ -47,9 +47,16 @@ class PredictableVariables(ProbeModule):
     )
     pre_hooks = ["JUMPI", "BLOCKHASH"]
     post_hooks = ["BLOCKHASH"] + list(BLOCK_VARIABLE_OPS)
-    # JUMPI reads condition taints only -> replayable at lift time; the
-    # taint sources (block-var reads, BLOCKHASH) stay host-hooked
-    tape_replay_hooks = frozenset({"JUMPI"})
+    # JUMPI reads condition taints only -> replayable at lift time. The
+    # taint sources retire on device too: block-var reads are env-leaf
+    # tape nodes whose post-hook taint replays over the lifted value
+    # (replay_tape_value), and BLOCKHASH's stale-query pre-check folds
+    # into the same value replay (the queried number rides as the node's
+    # argument).
+    tape_replay_hooks = frozenset({"JUMPI", "BLOCKHASH"})
+    tape_replay_post_hooks = frozenset(
+        {"BLOCKHASH"} | set(BLOCK_VARIABLE_OPS)
+    )
 
     title = "Dependence on predictable environment variable"
     severity = "Low"
@@ -97,6 +104,42 @@ class PredictableVariables(ProbeModule):
         )
 
     # -- taint sink --------------------------------------------------------
+
+    def replay_tape_value(self, origin, opcode: str, value, arg):
+        """Batch-aware taint sources: the post-hook taints replay over
+        the lifted env-leaf value; BLOCKHASH folds its stale-query
+        pre-check in (the queried number is the node's argument, the
+        origin carries the constraints in force at the read).
+
+        One accepted divergence from the host: staleness is decided per
+        query here, while the host's StaleBlockhashQuery STATE annotation
+        is sticky — after one provably-stale query the host taints every
+        later BLOCKHASH result on that path. Per-query is the tighter
+        reading of SWC-120."""
+        if opcode == "BLOCKHASH":
+            if arg is None or not self._stale_query(origin, arg):
+                return None
+            taint = PredictableTaint("The block hash of a previous block")
+        else:
+            taint = PredictableTaint(
+                "The block.{} environment variable".format(opcode.lower())
+            )
+        return BitVec(
+            value.raw, annotations=set(value.annotations) | {taint}
+        )
+
+    @staticmethod
+    def _stale_query(origin, queried) -> bool:
+        current = origin.environment.block_number
+        past_block = [
+            ULT(queried, current),
+            ULT(current, symbol_factory.BitVecVal(2 ** 255, 256)),
+        ]
+        try:
+            solver.get_model(origin.world_state.constraints + past_block)
+            return True
+        except UnsatError:
+            return False
 
     def _branch_findings(self, state):
         condition = state.mstate.stack[-2]

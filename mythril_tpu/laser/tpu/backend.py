@@ -42,12 +42,16 @@ from mythril_tpu.laser.tpu.batch import (
 )
 from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
 from mythril_tpu.laser.tpu.engine import run, run_with_stats
-from mythril_tpu.laser.tpu import solver_jax, transfer
+from mythril_tpu.laser.tpu import solver_jax, symtape, transfer
 from mythril_tpu.support.opcodes import OPCODES
 
 log = logging.getLogger(__name__)
 
-# ops that end a transaction or leave the device model — always host-side
+# ops that end a transaction or leave the device model — always host-side.
+# Block-context reads (TIMESTAMP/NUMBER/...) are NOT here: they retire on
+# device as env-leaf tape nodes (symtape.ENV_LEAF_OP) that lift to the
+# same symbols the host mints, with taint post-hooks replayed at lift.
+# GAS stays device-modeled as the concrete per-lane gas counter.
 _ALWAYS_HOST = (
     "STOP",
     "RETURN",
@@ -55,17 +59,6 @@ _ALWAYS_HOST = (
     "SUICIDE",
     "ASSERT_FAIL",
     "INVALID",
-    # block-context ops push SYMBOLIC values on the host (environment.py
-    # block_number/chainid); the device only has concrete placeholders
-    "TIMESTAMP",
-    "NUMBER",
-    "DIFFICULTY",
-    "COINBASE",
-    "GASLIMIT",
-    "CHAINID",
-    "BASEFEE",
-    "BLOCKHASH",
-    "GASPRICE",
 )
 
 _NAME_TO_BYTE = {spec.name: byte for byte, spec in OPCODES.items()}
@@ -131,12 +124,54 @@ def find_tpu_strategy(strategy) -> Optional[TpuBatchStrategy]:
 # accepting it elsewhere would silently drop the hook
 _RAW_REPLAY_OPS = frozenset({"SSTORE"})
 
+# opcodes with a VALUE-replay channel: they retire on device as env-leaf
+# tape nodes (symtape.ENV_LEAF_OP / OP_ORIGIN), and a module's post-hook
+# semantics (taint the pushed value) replay over the lifted value when
+# the module declares the opcode in tape_replay_post_hooks
+_VALUE_REPLAY_OPS = {
+    "ORIGIN": symtape.OP_ORIGIN,
+    "COINBASE": symtape.OP_COINBASE,
+    "TIMESTAMP": symtape.OP_TIMESTAMP,
+    "NUMBER": symtape.OP_NUMBER,
+    "DIFFICULTY": symtape.OP_DIFFICULTY,
+    "GASLIMIT": symtape.OP_GASLIMIT,
+    "CHAINID": symtape.OP_CHAINID,
+    "BASEFEE": symtape.OP_BASEFEE,
+    "GASPRICE": symtape.OP_GASPRICE,
+    "BLOCKHASH": symtape.OP_BLOCKHASH,
+}
+
+
+def _replayable_post_hook(name: str, hooks) -> bool:
+    """True when every post-hook on ``name`` can replay over the lifted
+    value: the opcode has a value-replay channel and every hook is a
+    bound method of a module declaring it in tape_replay_post_hooks."""
+    if name not in _VALUE_REPLAY_OPS:
+        return False
+    for hook in hooks:
+        owner = getattr(hook, "__self__", None)
+        if owner is None or name not in getattr(
+            owner, "tape_replay_post_hooks", frozenset()
+        ):
+            return False
+    return True
+
 
 def _replayable_pre_hook(name: str, hooks) -> bool:
     """True when every pre-hook on ``name`` is batch-aware: either a
     bound method of a detection module declaring the opcode in
     tape_replay_hooks, or — on opcodes with a raw-hook replay channel —
-    a plugin hook self-marked tape_replay_safe."""
+    a plugin hook self-marked tape_replay_safe.
+
+    A tape_replay_hooks declaration is a module-owned CONTRACT, not a
+    routing request: the module asserts its pre-hook either (a) replays
+    through an existing channel (per-node: ADD/SUB/MUL/EXP, site replay:
+    JUMPI, event ring: SSTORE), (b) folds into its replay_tape_value on
+    a value-channel opcode (BLOCKHASH's stale-query check), or (c) is
+    safe to skip at device-retired sites because the condition it probes
+    always traps anyway (JUMP/SLOAD window cases). Declaring an opcode
+    with none of these holding silently drops the hook on device paths —
+    keep the declaration next to the replay implementation."""
     for hook in hooks:
         if name in _RAW_REPLAY_OPS and getattr(hook, "tape_replay_safe", False):
             continue
@@ -155,12 +190,17 @@ def host_op_bytes(laser) -> set:
     post-hooks) retires on device; the bridge replays the hooks over the
     lifted tape at unpack time."""
     hooked = set()
+
+    def post_ok(name):
+        post = laser.post_hooks.get(name)
+        return not post or _replayable_post_hook(name, post)
+
     for name, hooks in laser.pre_hooks.items():
         if not hooks:
             continue
         if name == "*":
             return set(range(256))
-        if _replayable_pre_hook(name, hooks) and not laser.post_hooks.get(name):
+        if _replayable_pre_hook(name, hooks) and post_ok(name):
             continue
         byte = _NAME_TO_BYTE.get(name)
         if byte is not None:
@@ -170,6 +210,8 @@ def host_op_bytes(laser) -> set:
             continue
         if name == "*":
             return set(range(256))
+        if _replayable_post_hook(name, hooks):
+            continue
         byte = _NAME_TO_BYTE.get(name)
         if byte is not None:
             hooked.add(byte)
@@ -214,6 +256,31 @@ def tape_replayers_for(laser) -> dict:
         and not laser.post_hooks.get("SSTORE")
     ):
         out["SSTORE"] = list(sstore_hooks)
+    return out
+
+
+def value_replayers_for(laser) -> dict:
+    """Value-replay dispatch: symtape node op -> [(module, opcode name)]
+    for every env-leaf opcode whose post-hook owners are batch-aware
+    (tape_replay_post_hooks). The bridge fires these over the LIFTED
+    value so taints land exactly where the host post-hook would put
+    them; a module hooked on both sides (BLOCKHASH pre+post) registers
+    once and replays both semantics in replay_tape_value."""
+    out: dict = {}
+    for name, tape_op in _VALUE_REPLAY_OPS.items():
+        owners: list = []
+        for hook in list(laser.post_hooks.get(name, ())) + list(
+            laser.pre_hooks.get(name, ())
+        ):
+            owner = getattr(hook, "__self__", None)
+            if (
+                owner is not None
+                and name in getattr(owner, "tape_replay_post_hooks", frozenset())
+                and owner not in owners
+            ):
+                owners.append(owner)
+        if owners:
+            out[tape_op] = [(owner, name) for owner in owners]
     return out
 
 
@@ -551,6 +618,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
     cfg = strategy.batch_cfg
     host_ops = host_op_bytes(laser)
     replayers = tape_replayers_for(laser)
+    val_replayers = value_replayers_for(laser)
     seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
     final_states: List[GlobalState] = []
     budget_deadline = (
@@ -619,6 +687,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             host_ops=host_ops,
             freeze_errors=True,
             tape_replayers=replayers,
+            value_replayers=val_replayers,
         )
         packed_states = []
         for state in to_pack:

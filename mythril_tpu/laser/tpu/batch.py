@@ -66,17 +66,11 @@ class CodeBank(NamedTuple):
 
 
 class Env(NamedTuple):
-    """Block-level context shared by every lane (words, shape [16])."""
-
-    number: jnp.ndarray
-    timestamp: jnp.ndarray
-    coinbase: jnp.ndarray
-    difficulty: jnp.ndarray
-    gaslimit: jnp.ndarray
-    chainid: jnp.ndarray
-    basefee: jnp.ndarray
-    gasprice: jnp.ndarray
-    blockhash: jnp.ndarray  # single modeled hash for BLOCKHASH
+    """Lane-shared block context: EMPTY by design. Block/tx environment
+    reads (TIMESTAMP/NUMBER/...) retire as symbolic tape leaves
+    (symtape.ENV_LEAF_OP) that the bridge lifts to host symbols, so the
+    kernel carries no concrete env words; the tuple survives as the
+    run()/mesh plumbing slot for future genuinely-shared context."""
 
 
 # depth of the on-device JUMPDEST ring buffer: bounded-loop detection sees
@@ -277,18 +271,7 @@ def make_code_bank(codes, code_len: int, host_ops=None, freeze_errors=False) -> 
 
 
 def default_env() -> Env:
-    w = lambda x: jnp.asarray(words.from_int(x))
-    return Env(
-        number=w(17_000_000),
-        timestamp=w(1_700_000_000),
-        coinbase=w(0xC0FFEE),
-        difficulty=w(0x0200000),
-        gaslimit=w(30_000_000),
-        chainid=w(1),
-        basefee=w(10**9),
-        gasprice=w(10**9),
-        blockhash=w(0xB10C4A54),
-    )
+    return Env()
 
 
 def append_node(np_batch: dict, lane: int, op: int, a: int = 0, b: int = 0, imm=None) -> int:

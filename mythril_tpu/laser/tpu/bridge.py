@@ -137,6 +137,7 @@ class DeviceBridge:
         host_ops=None,
         freeze_errors: bool = False,
         tape_replayers=None,
+        value_replayers=None,
     ):
         self.cfg = cfg
         self.host_ops = host_ops
@@ -145,6 +146,11 @@ class DeviceBridge:
         # modules whose pre-hook is replayed over device-allocated tape
         # nodes at lift time instead of freeze-trapping the opcode
         self.tape_replayers = tape_replayers or {}
+        # symtape op -> [(detection module, EVM opcode name)]: modules
+        # whose POST-hook semantics (taint the pushed value) replay over
+        # the LIFTED value of an env-leaf node. Fired for packed nodes
+        # too: the taint is a property of the value, not the site.
+        self.value_replayers = value_replayers or {}
         self.packed_tape_len: List[int] = []
         self.seeds: List[GlobalState] = []
         self.opaque: List[BitVec] = []
@@ -691,6 +697,42 @@ class DeviceBridge:
                 v = If(x == y, one, zero)
             elif op == symtape.OP_ISZERO:
                 v = If(x == zero, one, zero)
+            # env-leaf nodes lift to EXACTLY the term the host instruction
+            # pushes (instructions.py _stamp_block_context / number_ /
+            # _NULLARY_PUSH_OPS), including concolic block_context pins,
+            # so constraints line up across interpreters
+            elif op == symtape.OP_TIMESTAMP:
+                v = self._block_context_symbol(seed, "timestamp", "timestamp")
+            elif op == symtape.OP_COINBASE:
+                v = self._block_context_symbol(seed, "coinbase", "coinbase")
+            elif op == symtape.OP_DIFFICULTY:
+                v = self._block_context_symbol(
+                    seed, "difficulty", "block_difficulty"
+                )
+            elif op == symtape.OP_BASEFEE:
+                v = self._block_context_symbol(seed, "basefee", "basefee")
+            elif op == symtape.OP_NUMBER:
+                v = env.block_number
+            elif op == symtape.OP_CHAINID:
+                v = env.chainid
+            elif op == symtape.OP_GASPRICE:
+                gp = env.gasprice
+                v = (
+                    gp
+                    if isinstance(gp, BitVec)
+                    else symbol_factory.BitVecVal(int(gp), 256)
+                )
+            elif op == symtape.OP_GASLIMIT:
+                gl = seed.mstate.gas_limit
+                v = (
+                    gl
+                    if isinstance(gl, BitVec)
+                    else symbol_factory.BitVecVal(int(gl), 256)
+                )
+            elif op == symtape.OP_BLOCKHASH:
+                # mirror instructions.py blockhash_: symbol named after
+                # the queried number's printed form
+                v = seed.new_bitvec("blockhash_block_" + str(x), 256)
             else:
                 raise ValueError(f"unknown tape op {op}")
             # re-attach pack-time annotations (taint) without mutating
@@ -698,11 +740,60 @@ class DeviceBridge:
             ann = self.pack_annotations.get((seed_id_val, i + 1))
             if ann and isinstance(v, BitVec):
                 v = BitVec(v.raw, annotations=set(v.annotations) | ann)
+            # post-hook replay over the lifted value (block-var taints):
+            # fired for packed nodes too — the taint is a property of the
+            # value, not of the instruction site
+            if self.value_replayers and op in self.value_replayers:
+                v = self._replay_value(
+                    seed, op, int(metas[i]), x, v, values, side,
+                    path_ids, path_signs,
+                )
             values[i] = v
         return values, side
 
     # ------------------------------------------------------------------
     # unpacking
+
+    @staticmethod
+    def _block_context_symbol(seed, ctx_key: str, symbol_name: str):
+        """The term a block-context opcode pushes on the host: the
+        concolic pin when one is set, a tx-scoped symbol otherwise
+        (instructions.py _stamp_block_context)."""
+        pinned = seed.environment.block_context.get(ctx_key)
+        if pinned is not None:
+            return pinned
+        return seed.new_bitvec(symbol_name, 256)
+
+    def _node_origin(self, seed, meta, values, side, path_ids, path_signs):
+        """TapeOrigin for a node: its pc and the constraints in force at
+        allocation. Pack-time nodes (HOST_META) have no device site —
+        pc -1, seed constraints only."""
+        unpacked = symtape.unpack_meta(meta)
+        # materialize the origin's path-condition terms NOW (they are
+        # already-built earlier tape nodes) so the lazy constraints
+        # closure pins a handful of terms, not the whole lift scope
+        zero = symbol_factory.BitVecVal(0, 256)
+        prefix_conds = []
+        pc = -1
+        if unpacked is not None:
+            pc, plen = unpacked
+            for j in range(plen):
+                node_id = int(path_ids[j])
+                if node_id <= 0 or values[node_id - 1] is None:
+                    continue
+                w = values[node_id - 1]
+                prefix_conds.append(
+                    Not(w == zero) if path_signs[j] else (w == zero)
+                )
+        seed_constraints = seed.world_state.constraints
+        side_snapshot = list(side)
+        return TapeOrigin(
+            pc,
+            seed,
+            lambda: self._origin_constraints(
+                seed_constraints, side_snapshot, prefix_conds
+            ),
+        )
 
     def _replay_node(
         self, seed, op, index, meta, x, y, values, side, path_ids, path_signs
@@ -714,37 +805,33 @@ class DeviceBridge:
         dependent lifted value exactly as they do through host execution,
         so downstream sink collection (on still-hooked opcodes) and
         settlement need no changes."""
-        unpacked = symtape.unpack_meta(meta)
-        if unpacked is None:
+        if symtape.unpack_meta(meta) is None:
             return
-        pc, plen = unpacked
-        # materialize the origin's path-condition terms NOW (they are
-        # already-built earlier tape nodes) so the lazy constraints
-        # closure pins a handful of terms, not the whole lift scope
-        zero = symbol_factory.BitVecVal(0, 256)
-        prefix_conds = []
-        for j in range(plen):
-            node_id = int(path_ids[j])
-            if node_id <= 0 or values[node_id - 1] is None:
-                continue
-            w = values[node_id - 1]
-            prefix_conds.append(
-                Not(w == zero) if path_signs[j] else (w == zero)
-            )
-        seed_constraints = seed.world_state.constraints
-        side_snapshot = list(side)
-        origin = TapeOrigin(
-            pc,
-            seed,
-            lambda: self._origin_constraints(
-                seed_constraints, side_snapshot, prefix_conds
-            ),
-        )
+        origin = self._node_origin(seed, meta, values, side, path_ids, path_signs)
         for module, opcode_name in self.tape_replayers[op]:
             try:
                 module.replay_tape_node(origin, opcode_name, x, y)
             except Exception as e:  # pragma: no cover - module bugs degrade
                 log.warning("tape replay failed (%s): %s", opcode_name, e)
+
+    def _replay_value(
+        self, seed, op, meta, x, v, values, side, path_ids, path_signs
+    ):
+        """Replay POST-hook semantics over a lifted env-leaf value.
+
+        Modules return a replacement wrapper (same raw term, taint
+        annotations added) or None to keep ``v``; replacing instead of
+        mutating keeps shared seed wrappers (env.origin et al.) clean
+        across lanes."""
+        origin = self._node_origin(seed, meta, values, side, path_ids, path_signs)
+        for module, opcode_name in self.value_replayers[op]:
+            try:
+                replacement = module.replay_tape_value(origin, opcode_name, v, x)
+                if replacement is not None:
+                    v = replacement
+            except Exception as e:  # pragma: no cover - module bugs degrade
+                log.warning("value replay failed (%s): %s", opcode_name, e)
+        return v
 
     @staticmethod
     def _origin_constraints(seed_constraints, side_conds, prefix_conds):

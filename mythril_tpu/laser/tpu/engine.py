@@ -361,17 +361,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     res = _sel(res, opmask(0x34), st.callvalue)
     res = _sel(res, opmask(0x36), words.from_u32(st.calldata_len.astype(U32)))
     res = _sel(res, opmask(0x38), words.from_u32(my_code_len.astype(U32)))
-    res = _sel(res, opmask(0x3A), jnp.broadcast_to(env.gasprice, (L, words.NDIGITS)))
     res = _sel(res, opmask(0x3D), words.zeros((L,)))  # RETURNDATASIZE: no call yet
-    res = _sel(res, opmask(0x40), jnp.broadcast_to(env.blockhash, (L, words.NDIGITS)))
-    res = _sel(res, opmask(0x41), jnp.broadcast_to(env.coinbase, (L, words.NDIGITS)))
-    res = _sel(res, opmask(0x42), jnp.broadcast_to(env.timestamp, (L, words.NDIGITS)))
-    res = _sel(res, opmask(0x43), jnp.broadcast_to(env.number, (L, words.NDIGITS)))
-    res = _sel(res, opmask(0x44), jnp.broadcast_to(env.difficulty, (L, words.NDIGITS)))
-    res = _sel(res, opmask(0x45), jnp.broadcast_to(env.gaslimit, (L, words.NDIGITS)))
-    res = _sel(res, opmask(0x46), jnp.broadcast_to(env.chainid, (L, words.NDIGITS)))
+    # 0x3A GASPRICE and 0x40-0x46/0x48 (block context) push env-leaf tape
+    # nodes, not concrete words — see the env-leaf alloc below
     res = _sel(res, opmask(0x47), st.balance)  # SELFBALANCE
-    res = _sel(res, opmask(0x48), jnp.broadcast_to(env.basefee, (L, words.NDIGITS)))
     res = _sel(res, opmask(0x58), words.from_u32(st.pc.astype(U32)))
     res = _sel(res, opmask(0x59), words.from_u32((st.mem_words * 32).astype(U32)))
     # GAS pushes gas remaining *after* charging its own 2 gas
@@ -383,6 +376,29 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     self_balance_hit = is_balance & ~has_a & words.eq(a, st.address)
     res = _sel(res, self_balance_hit, st.balance)
     balance_trap = is_balance & ~self_balance_hit
+
+    # ------------------------------------------------------------------
+    # block/tx environment reads retire as tape LEAVES: the host pushes
+    # symbols for these (environment.py block_number/chainid, the
+    # _stamp_block_context handlers), so the concrete env placeholders
+    # above are never authoritative — the leaf tag is. Per-lane CSE
+    # dedupes repeated reads onto one node, mirroring the host where
+    # every read in a transaction mints the same-named symbol.
+    # BLOCKHASH consumes its queried number as the node argument (a ref
+    # when the number is itself symbolic).
+    env_leaf_op = jnp.asarray(symtape.ENV_LEAF_OP)[op]
+    is_blockhash = opmask(0x40)
+    env_leaf_mask = ok_lane & (env_leaf_op > 0)
+    env_node_a = jnp.where(
+        is_blockhash, jnp.where(has_a, sym_a, I32(symtape.ARG_IMM)), 0
+    )
+    env_imm = jnp.where(
+        (is_blockhash & ~has_a)[:, None], a, jnp.zeros_like(a)
+    )
+    tapes, env_leaf_id, env_ok = symtape.alloc(
+        tapes, env_leaf_mask, env_leaf_op, env_node_a, zero, env_imm,
+        alloc_meta,
+    )
 
     # ------------------------------------------------------------------
     # CALLDATALOAD / MLOAD (32-byte gathers)
@@ -762,10 +778,9 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
 
     # ------------------------------------------------------------------
     # status resolution (order matters)
-    alloc_trap = ~(alu_ok & cdload_ok & sload_ok & sha_ok)
+    alloc_trap = ~(alu_ok & cdload_ok & sload_ok & sha_ok & env_ok)
     sym_trap = (
         jump_dest_sym_trap
-        | (opmask(0x40) & has_a)  # BLOCKHASH of a symbolic number -> host
         | (modal & (has_a | has_b | has_c))
         | ((is_mload | is_mstore | is_mstore8) & has_a)
         | (is_mstore8 & has_b)
@@ -850,6 +865,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     res_sym = jnp.where(opmask(0x36), st.cdsize_sym, res_sym)
     res_sym = jnp.where(opmask(0x47), st.balance_sym, res_sym)
     res_sym = jnp.where(self_balance_hit, st.balance_sym, res_sym)
+    res_sym = jnp.where(env_leaf_mask, env_leaf_id, res_sym)
     res_sym = jnp.where(sha_sym_mask, sha_id, res_sym)
     res_sym = jnp.where(is_dup, dup_tag, res_sym)
 

@@ -70,6 +70,20 @@ OP_ISZERO = 32
 # --- keccak -----------------------------------------------------------------
 OP_COMB = 33  # one 32-byte word of a keccak preimage; a = word, b = rest chain
 OP_SHA3 = 34  # a = COMB chain; imm[0] = preimage byte length
+# --- block/tx environment leaves --------------------------------------------
+# Reads the host models as symbols (environment.py block_number/chainid,
+# instructions.py _stamp_block_context): on device they retire as tape
+# leaves and the bridge lifts each to the SAME term the host instruction
+# would push, so constraints and taint annotations line up exactly.
+OP_TIMESTAMP = 35
+OP_NUMBER = 36
+OP_DIFFICULTY = 37
+OP_COINBASE = 38
+OP_GASLIMIT = 39
+OP_CHAINID = 40
+OP_BASEFEE = 41
+OP_GASPRICE = 42
+OP_BLOCKHASH = 43  # a = queried block number (ref or ARG_IMM)
 
 # EVM opcode byte -> (tape op, arity); 0 = this opcode never allocates.
 SYM_OP = np.zeros(256, dtype=np.int32)
@@ -86,6 +100,23 @@ for _byte, _top, _ar in [
 ]:
     SYM_OP[_byte] = _top
     SYM_ARITY[_byte] = _ar
+
+# EVM opcode byte -> env-leaf tape op (0 = not an env leaf). These
+# opcodes allocate a leaf node UNCONDITIONALLY when executed on device
+# (the host pushes a symbol for them regardless of operand taggedness).
+ENV_LEAF_OP = np.zeros(256, dtype=np.int32)
+for _byte, _top in [
+    (0x3A, OP_GASPRICE),
+    (0x40, OP_BLOCKHASH),
+    (0x41, OP_COINBASE),
+    (0x42, OP_TIMESTAMP),
+    (0x43, OP_NUMBER),
+    (0x44, OP_DIFFICULTY),
+    (0x45, OP_GASLIMIT),
+    (0x46, OP_CHAINID),
+    (0x48, OP_BASEFEE),
+]:
+    ENV_LEAF_OP[_byte] = _top
 
 
 def _mix(h, v, mul):

@@ -1,0 +1,149 @@
+"""Block-env opcodes retire on device as tape leaves (VERDICT r3 #5).
+
+TIMESTAMP/NUMBER/BLOCKHASH/... no longer freeze-trap every read: they
+allocate env-leaf tape nodes (symtape.ENV_LEAF_OP), the bridge lifts
+each to the same symbol the host instruction would push, and the taint
+post-hooks of the SWC-115/116/120 modules replay over the lifted value.
+These tests pin that the flagship contracts for those detectors run
+device-dominant with unchanged findings (reference behavior surface:
+mythril/analysis/modules/dependence_on_predictable_vars.py).
+"""
+
+import numpy as np
+import pytest
+
+import mythril_tpu.laser.tpu.backend as backend
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.laser.tpu.batch import BatchConfig
+
+TEST_CFG = BatchConfig(
+    lanes=32,
+    stack_slots=16,
+    memory_bytes=256,
+    calldata_bytes=128,
+    storage_slots=8,
+    code_len=512,
+    tape_slots=64,
+    path_slots=16,
+    mem_sym_slots=8,
+)
+
+
+@pytest.fixture(autouse=True)
+def small_batch(monkeypatch):
+    monkeypatch.setattr(backend, "DEFAULT_BATCH_CFG", TEST_CFG)
+
+
+def analyze(runtime_src: str, modules, strategy="tpu-batch", tx=1):
+    runtime = assemble(runtime_src).hex()
+    n = len(runtime) // 2
+    creation = (
+        assemble(
+            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+            "PUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + runtime
+    )
+    contract = EVMContract(code=runtime, creation_code=creation, name="T")
+    sym = SymExecWrapper(
+        contract,
+        address=0x1234,
+        strategy=strategy,
+        execution_timeout=240,
+        transaction_count=tx,
+        max_depth=64,
+        modules=modules,
+    )
+    issues = fire_lasers(sym, modules)
+    strategy_obj = backend.find_tpu_strategy(sym.laser.strategy)
+    return issues, sym, strategy_obj
+
+
+# branch on block.timestamp & 7 — the SWC-116 shape
+TIMESTAMP_SRC = """
+TIMESTAMP
+PUSH1 0x07
+AND
+PUSH1 :yes
+JUMPI
+STOP
+yes:
+JUMPDEST
+STOP
+"""
+
+# branch on block.number parity — SWC-120
+NUMBER_SRC = """
+NUMBER
+PUSH1 0x01
+AND
+PUSH1 :yes
+JUMPI
+STOP
+yes:
+JUMPDEST
+STOP
+"""
+
+# branch on blockhash(block.number - 1) — a provably stale query, SWC-120
+BLOCKHASH_SRC = """
+PUSH1 0x01
+NUMBER
+SUB
+BLOCKHASH
+PUSH1 0x01
+AND
+PUSH1 :yes
+JUMPI
+STOP
+yes:
+JUMPDEST
+STOP
+"""
+
+
+def swc_set(issues):
+    out = set()
+    for issue in issues:
+        out.update(issue.swc_id.split())
+    return out
+
+
+def test_timestamp_retires_on_device_with_swc116():
+    issues, _sym, strategy = analyze(TIMESTAMP_SRC, ["PredictableVariables"])
+    assert "116" in swc_set(issues)
+    assert strategy.device_steps_retired > 0
+
+
+def test_number_retires_on_device_with_swc120():
+    issues, _sym, strategy = analyze(NUMBER_SRC, ["PredictableVariables"])
+    assert "120" in swc_set(issues)
+    assert strategy.device_steps_retired > 0
+
+
+def test_stale_blockhash_on_device_swc120():
+    issues, _sym, strategy = analyze(BLOCKHASH_SRC, ["PredictableVariables"])
+    assert "120" in swc_set(issues)
+    assert strategy.device_steps_retired > 0
+
+
+def test_block_ops_not_in_trap_set():
+    """With only batch-aware hookers loaded, the whole block-env family
+    retires on device instead of freeze-trapping per read."""
+    _issues, sym, _strategy = analyze(
+        TIMESTAMP_SRC, ["PredictableVariables", "TxOrigin"]
+    )
+    hooked = backend.host_op_bytes(sym.laser)
+    for byte in (0x32, 0x3A, 0x40, 0x41, 0x42, 0x43, 0x44, 0x45, 0x46, 0x48):
+        assert byte not in hooked, hex(byte)
+
+
+def test_host_device_parity_on_block_env():
+    for src, swc in ((TIMESTAMP_SRC, "116"), (NUMBER_SRC, "120")):
+        host_issues, _s, _t = analyze(src, ["PredictableVariables"], strategy="bfs")
+        dev_issues, _s, _t = analyze(src, ["PredictableVariables"])
+        assert swc_set(host_issues) == swc_set(dev_issues)
+        assert swc in swc_set(dev_issues)

@@ -455,7 +455,12 @@ def test_gas_spent_max_exceeds_min_on_symbolic_sstore():
     assert spent_max - spent_min == 20000
 
 
-def test_blockhash_of_symbolic_number_traps():
+def test_blockhash_of_symbolic_number_retires_as_leaf():
+    """BLOCKHASH is an env leaf (symtape.OP_BLOCKHASH): a symbolic query
+    number rides as the node's argument instead of freeze-trapping, and
+    the dependent JUMPI forks on the tagged condition."""
+    from mythril_tpu.laser.tpu import symtape
+
     src = """
     PUSH1 0x00
     CALLDATALOAD
@@ -468,8 +473,16 @@ def test_blockhash_of_symbolic_number_traps():
     STOP
     """
     out = run_src(src)
-    assert int(np.asarray(out.status)[0]) == TRAP
-    assert int(np.asarray(out.trap_op)[0]) == 0x40
+    assert int(np.asarray(out.status)[0]) == STOPPED
+    ops = np.asarray(out.tape_op)[0]
+    bh_rows = np.nonzero(ops == symtape.OP_BLOCKHASH)[0]
+    assert bh_rows.size == 1
+    # the queried number is the CDLOAD node, carried by reference
+    arg = int(np.asarray(out.tape_a)[0][bh_rows[0]])
+    assert arg > 0
+    assert int(ops[arg - 1]) == symtape.OP_CDLOAD
+    # the symbolic branch forked a second lane
+    assert int(np.asarray(out.alive).sum()) == 2
 
 
 def test_symbolic_sstore_zeroes_concrete_plane():
