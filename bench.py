@@ -167,7 +167,9 @@ def _device_states_per_sec(code: bytes, lanes: int) -> float:
     return float(np.asarray(out.steps).sum()) / dt
 
 
-def _integrated_pipeline(creation_hex: str, runtime_hex: str, budget_s: int = 60):
+def _integrated_pipeline(
+    creation_hex: str, runtime_hex: str, budget_s: int = 60, name="BECStress"
+):
     """The PRODUCT number: full tpu-batch analysis (device engine + batched
     feasibility + detection modules + witness solving) on the stress
     contract. Returns (states/s incl. device-retired, issue SWC ids)."""
@@ -177,7 +179,7 @@ def _integrated_pipeline(creation_hex: str, runtime_hex: str, budget_s: int = 60
     from mythril_tpu.ethereum.evmcontract import EVMContract
 
     contract = EVMContract(
-        code=runtime_hex, creation_code=creation_hex, name="BECStress"
+        code=runtime_hex, creation_code=creation_hex, name=name
     )
     # compile the device kernels before the clock starts: the measured
     # number is the pipeline's throughput, not XLA's compile latency
@@ -225,6 +227,28 @@ def main() -> int:
         creation_hex, runtime.hex()
     )
 
+    # the BASELINE.md north-star workload: the faithful BECToken
+    # batchTransfer reproduction (bench_contracts/bectoken.asm — no solc
+    # in this image, see the .asm header), through the same product
+    # pipeline. SWC-101 is the CVE-2018-10299 overflow.
+    bec_src = open(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_contracts", "bectoken.asm")
+    ).read()
+    bec_runtime = assemble(bec_src)
+    bn = len(bec_runtime)
+    bec_creation = (
+        assemble(
+            f"PUSH2 {bn}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\n"
+            f"PUSH2 {bn}\nPUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + bec_runtime.hex()
+    )
+    bec_host_rate = _host_states_per_sec(bec_creation)
+    bec_rate, bec_swcs = _integrated_pipeline(
+        bec_creation, bec_runtime.hex(), name="BECToken"
+    )
+
     print(
         json.dumps(
             {
@@ -238,6 +262,11 @@ def main() -> int:
                     integrated_rate / max(host_rate, 1e-9), 2
                 ),
                 "integrated_swcs": integrated_swcs,
+                "bectoken_states_per_sec": round(bec_rate, 1),
+                "bectoken_vs_host": round(
+                    bec_rate / max(bec_host_rate, 1e-9), 2
+                ),
+                "bectoken_swcs": bec_swcs,
                 "lanes": lanes,
                 "platform": platform,
             }

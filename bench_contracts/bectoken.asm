@@ -1,0 +1,125 @@
+; BECToken batchTransfer — the north-star benchmark workload
+; (BASELINE.md "BECToken.sol -t 3").
+;
+; Hand-assembled reproduction of the CVE-2018-10299 function from the
+; reference's solidity_examples/BECToken.sol: this image ships no solc
+; and has zero network egress, so the Solidity source cannot be
+; compiled here; this is a faithful EVM-level port of the vulnerable
+; function (selector dispatch, ABI-encoded dynamic address[] calldata,
+; the unchecked cnt*value multiplication, a keccak-mapped balance for
+; msg.sender, and the receiver credit loop).
+;
+;   function batchTransfer(address[] _receivers, uint256 _value) {
+;       uint cnt = _receivers.length;
+;       uint256 amount = uint256(cnt) * _value;        // SWC-101
+;       require(cnt > 0 && cnt <= 20);
+;       require(_value > 0 && balances[msg.sender] >= amount);
+;       balances[msg.sender] -= amount;
+;       for (uint i = 0; i < cnt; i++)
+;           balances[_receivers[i]] += _value;          // SWC-101
+;   }
+;
+; Simplification vs solc output: the balances mapping key is
+; keccak256(addr) instead of keccak256(addr . slot) — one fewer MSTORE
+; per access, detection-equivalent (same hazard sites, same SWC ids).
+
+PUSH1 0x00
+CALLDATALOAD
+PUSH1 0xE0
+SHR                     ; [selector]
+DUP1
+PUSH4 0x83f12fec        ; batchTransfer(address[],uint256)
+EQ
+PUSH2 :batch
+JUMPI
+STOP
+
+batch:
+JUMPDEST
+POP                     ; []
+PUSH1 0x44
+CALLDATALOAD            ; [cnt]        (array length word)
+PUSH1 0x24
+CALLDATALOAD            ; [cnt, val]
+DUP1
+DUP3
+MUL                     ; [cnt, val, amount]   <-- overflow site
+DUP3
+ISZERO
+PUSH2 :rev
+JUMPI                   ; cnt == 0 -> revert
+DUP3
+PUSH1 0x14
+LT
+PUSH2 :rev
+JUMPI                   ; 20 < cnt -> revert
+DUP2
+ISZERO
+PUSH2 :rev
+JUMPI                   ; val == 0 -> revert
+CALLER
+PUSH1 0x00
+MSTORE
+PUSH1 0x20
+PUSH1 0x00
+SHA3                    ; [cnt, val, amount, slot]
+DUP1
+SLOAD                   ; [cnt, val, amount, slot, bal]
+DUP3
+SWAP1
+LT                      ; [cnt, val, amount, slot, bal < amount]
+PUSH2 :rev
+JUMPI                   ; insufficient balance -> revert
+DUP1
+SLOAD                   ; [cnt, val, amount, slot, bal]
+DUP3
+SWAP1
+SUB                     ; [cnt, val, amount, slot, bal - amount]
+SWAP1
+SSTORE                  ; [cnt, val, amount]
+PUSH1 0x00              ; [cnt, val, amount, i]
+
+loop:
+JUMPDEST
+DUP4
+DUP2
+LT                      ; [cnt, val, amount, i, i < cnt]
+ISZERO
+PUSH2 :done
+JUMPI
+DUP1
+PUSH1 0x20
+MUL
+PUSH1 0x64
+ADD
+CALLDATALOAD            ; [cnt, val, amount, i, receivers[i]]
+PUSH1 0x00
+MSTORE                  ; [cnt, val, amount, i]
+PUSH1 0x20
+PUSH1 0x00
+SHA3                    ; [cnt, val, amount, i, slot_r]
+DUP1
+SLOAD                   ; [cnt, val, amount, i, slot_r, bal_r]
+DUP5
+ADD                     ; [cnt, val, amount, i, slot_r, bal_r + val]   <-- overflow site
+SWAP1
+SSTORE                  ; [cnt, val, amount, i]
+PUSH1 0x01
+ADD                     ; [cnt, val, amount, i+1]
+PUSH2 :loop
+JUMP
+
+done:
+JUMPDEST
+PUSH1 0x01
+PUSH1 0x00
+MSTORE
+PUSH1 0x20
+PUSH1 0x00
+RETURN
+
+rev:
+JUMPDEST
+PUSH1 0x00
+PUSH1 0x00
+REVERT

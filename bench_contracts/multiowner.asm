@@ -1,0 +1,70 @@
+; rubixi / WalletLibrary shape — BASELINE.md row 4
+; ("rubixi.sol + WalletLibrary.sol -t 4": deep multi-tx state space).
+;
+; Hand-assembled reproduction (no solc in this image) of the hazard both
+; reference contracts share: an ownership slot that an unprotected
+; initializer lets anyone take over in one transaction, arming
+; owner-gated value transfers and self-destruction in later ones —
+; Rubixi's mis-named constructor (DynamicPyramid) and WalletLibrary's
+; unprotected initWallet. Finding the kill path needs >= 3 transactions
+; (deposit-ish state churn, takeover, then kill): exactly the deep
+; multi-tx exploration this row exists to stress.
+;
+; storage layout: slot 0 = owner, slot 1 = counter
+
+PUSH1 0x00
+CALLDATALOAD
+PUSH1 0xE0
+SHR                     ; [selector]
+DUP1
+PUSH4 0x90c3f38f        ; initWallet-alike: set owner = caller, UNPROTECTED
+EQ
+PUSH2 :init
+JUMPI
+DUP1
+PUSH4 0x41c0e1b5        ; kill(): owner-gated selfdestruct
+EQ
+PUSH2 :kill
+JUMPI
+DUP1
+PUSH4 0xd0e30db0        ; deposit(): counter churn (state-space filler)
+EQ
+PUSH2 :deposit
+JUMPI
+STOP
+
+init:
+JUMPDEST
+POP
+CALLER
+PUSH1 0x00
+SSTORE                  ; owner = msg.sender (anyone!)
+STOP
+
+deposit:
+JUMPDEST
+POP
+PUSH1 0x01
+SLOAD
+PUSH1 0x01
+ADD
+PUSH1 0x01
+SSTORE                  ; counter += 1
+STOP
+
+kill:
+JUMPDEST
+POP
+PUSH1 0x00
+SLOAD
+CALLER
+EQ
+ISZERO
+PUSH2 :nope
+JUMPI
+CALLER
+SELFDESTRUCT            ; reachable by anyone who ran init first
+
+nope:
+JUMPDEST
+STOP

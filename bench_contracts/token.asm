@@ -1,0 +1,52 @@
+; token.sol transfer — BASELINE.md row 1 ("token.sol -t 2").
+;
+; Hand-assembled reproduction (no solc in this image, zero egress) of
+; the reference's solidity_examples/token.sol transfer function: the
+; classic always-true balance check `balances[msg.sender] - _value >= 0`
+; whose unsigned subtraction underflows (SWC-101), then the unchecked
+; receiver credit. Balances key simplification as in bectoken.asm.
+
+PUSH1 0x00
+CALLDATALOAD
+PUSH1 0xE0
+SHR                     ; [selector]
+DUP1
+PUSH4 0xa9059cbb        ; transfer(address,uint256)
+EQ
+PUSH2 :xfer
+JUMPI
+STOP
+
+xfer:
+JUMPDEST
+POP                     ; []
+PUSH1 0x24
+CALLDATALOAD            ; [val]
+CALLER
+PUSH1 0x00
+MSTORE
+PUSH1 0x20
+PUSH1 0x00
+SHA3                    ; [val, slot_c]
+DUP1
+SLOAD                   ; [val, slot_c, bal]
+DUP3
+SWAP1
+SUB                     ; [val, slot_c, bal - val]   <-- underflow site
+SWAP1
+SSTORE                  ; [val]
+PUSH1 0x04
+CALLDATALOAD            ; [val, to]
+PUSH1 0x00
+MSTORE                  ; [val]
+PUSH1 0x20
+PUSH1 0x00
+SHA3                    ; [val, slot_t]
+DUP1
+SLOAD                   ; [val, slot_t, bal_t]
+DUP3
+ADD                     ; [val, slot_t, bal_t + val]  <-- overflow site
+SWAP1
+SSTORE                  ; [val]
+POP
+STOP

@@ -135,11 +135,11 @@ class SymExecWrapper:
         plugin_loader = LaserPluginLoader(self.laser)
         plugin_loader.load(PluginFactory.build_mutation_pruner_plugin())
         plugin_loader.load(coverage_plugin)
-        # The dependency pruner post-hooks every JUMP/JUMPI (block-entry
-        # tracking) and pre-hooks SLOAD/SSTORE, which would freeze-trap the
-        # device pipeline at every branch; under tpu-batch the batched
-        # frontier feasibility filter carries the pruning role instead.
-        if not disable_dependency_pruning and strategy != "tpu-batch":
+        # The dependency pruner's hooks are batch-aware (tape_replay_safe):
+        # under tpu-batch its SLOAD/SSTORE records replay from the tape and
+        # event ring, block entries from the jumpdest ring, and its prune
+        # decision applies at lift (PluginSkipState drops the lane).
+        if not disable_dependency_pruning:
             plugin_loader.load(PluginFactory.build_dependency_pruner_plugin())
         if checkpoint_dir:
             from mythril_tpu.support.checkpoint import CheckpointPlugin

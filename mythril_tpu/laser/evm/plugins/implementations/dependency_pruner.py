@@ -91,7 +91,16 @@ class BlockAccessIndex:
 
 
 class DependencyPruner(LaserPlugin):
-    """Skips repeat block entries that cannot observe last round's writes."""
+    """Skips repeat block entries that cannot observe last round's writes.
+
+    Batch-aware: every hook below is marked for device replay
+    (tape_replay_safe), so under tpu-batch the branches and storage ops
+    it watches retire on device and the bridge re-fires the hooks at
+    lift time — SLOAD/SSTORE from the tape/event ring, block entries
+    from the jumpdest ring plus symbolic-branch fall-through sites. A
+    device segment whose jumpdest ring overflowed cannot reconstruct
+    its full path, so pruning disables itself for the rest of the run
+    (sound: pruning off = reference behavior without the plugin)."""
 
     def __init__(self):
         self._reset()
@@ -99,10 +108,13 @@ class DependencyPruner(LaserPlugin):
     def _reset(self):
         self.iteration = 0
         self.index = BlockAccessIndex()
+        self.pruning_enabled = True
 
     # -- pruning decision ----------------------------------------------------
 
     def wanna_execute(self, block: int, annotation: DependencyAnnotation) -> bool:
+        if not self.pruning_enabled:
+            return True
         if block in self.index.calls:
             return True  # calls have unknowable effects; never prune
         block_reads = self.index.loads.get(block)
@@ -155,6 +167,21 @@ class DependencyPruner(LaserPlugin):
             if annotation.has_call:
                 self.index.record_call(annotation.path)
 
+        def on_device_overflow() -> None:
+            if self.pruning_enabled:
+                self.pruning_enabled = False
+                log.info(
+                    "a device segment's jumpdest ring overflowed; "
+                    "dependency pruning disabled for the rest of the run"
+                )
+
+        # device-replay contract: safe to re-fire these at synthesized
+        # sites (annotation/index bookkeeping over [slot]/[value, key]
+        # stack shims); the block-entry hook may raise PluginSkipState,
+        # which the backend maps to dropping the lifted state
+        on_block_entry.tape_replay_safe = True
+        on_block_entry.on_device_overflow = on_device_overflow
+
         @symbolic_vm.laser_hook("start_sym_trans")
         def start_sym_trans_hook():
             self.iteration += 1
@@ -169,6 +196,8 @@ class DependencyPruner(LaserPlugin):
             self.index.record_store(annotation.path, slot)
             annotation.extend_storage_write_cache(self.iteration, slot)
 
+        sstore_hook.tape_replay_safe = True
+
         @symbolic_vm.pre_hook("SLOAD")
         def sload_hook(state: GlobalState):
             annotation = path_annotation(state)
@@ -178,6 +207,8 @@ class DependencyPruner(LaserPlugin):
             # record against the whole path so far: execution may never
             # reach a clean transaction end
             self.index.record_load(annotation.path, slot)
+
+        sload_hook.tape_replay_safe = True
 
         for call_op in ("CALL", "STATICCALL"):
 

@@ -40,6 +40,7 @@ from mythril_tpu.laser.tpu.batch import (
     RUNNING,
     default_env,
 )
+from mythril_tpu.laser.evm.plugins.signals import PluginSkipState
 from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
 from mythril_tpu.laser.tpu.engine import run, run_with_stats
 from mythril_tpu.laser.tpu import solver_jax, symtape, transfer
@@ -119,10 +120,38 @@ def find_tpu_strategy(strategy) -> Optional[TpuBatchStrategy]:
 
 
 # opcodes whose skipped raw pre-hooks get re-fired at synthesized sites
-# by the bridge (currently only SSTORE has an event ring); a plugin's
-# tape_replay_safe marker is only honored where such a channel exists —
-# accepting it elsewhere would silently drop the hook
-_RAW_REPLAY_OPS = frozenset({"SSTORE"})
+# by the bridge (both from the ss_* storage event ring, in execution
+# order — bridge._replay_segment_sites); a plugin's tape_replay_safe
+# marker is only honored where such a channel exists — accepting it
+# elsewhere would silently drop the hook
+_RAW_REPLAY_OPS = frozenset({"SSTORE", "SLOAD"})
+
+# opcodes whose POST-hooks (block-entry tracking, dependency pruner) can
+# re-fire at lift over the reconstructed landing sequence: jumpdest-ring
+# entries plus symbolic-branch fall-through sites
+_RAW_POST_REPLAY_OPS = frozenset({"JUMP", "JUMPI"})
+
+
+def _replayable_raw_post_hook(name: str, hooks) -> bool:
+    """True when every post-hook on ``name`` is a plugin hook marked
+    tape_replay_safe and a site-replay channel exists for the opcode."""
+    if name not in _RAW_POST_REPLAY_OPS:
+        return False
+    return all(getattr(hook, "tape_replay_safe", False) for hook in hooks)
+
+
+def _post_hooks_ok(laser, name: str) -> bool:
+    """An opcode's post-hooks permit device retirement: none, or all
+    replayable through the value channel or the raw site channel. ONE
+    predicate shared by host_op_bytes (what retires) and
+    tape_replayers_for (what replays) — if these drifted apart, an
+    opcode could retire with its hooks silently dropped."""
+    post = laser.post_hooks.get(name)
+    return (
+        not post
+        or _replayable_post_hook(name, post)
+        or _replayable_raw_post_hook(name, post)
+    )
 
 # opcodes with a VALUE-replay channel: they retire on device as env-leaf
 # tape nodes (symtape.ENV_LEAF_OP / OP_ORIGIN), and a module's post-hook
@@ -190,17 +219,12 @@ def host_op_bytes(laser) -> set:
     post-hooks) retires on device; the bridge replays the hooks over the
     lifted tape at unpack time."""
     hooked = set()
-
-    def post_ok(name):
-        post = laser.post_hooks.get(name)
-        return not post or _replayable_post_hook(name, post)
-
     for name, hooks in laser.pre_hooks.items():
         if not hooks:
             continue
         if name == "*":
             return set(range(256))
-        if _replayable_pre_hook(name, hooks) and post_ok(name):
+        if _replayable_pre_hook(name, hooks) and _post_hooks_ok(laser, name):
             continue
         byte = _NAME_TO_BYTE.get(name)
         if byte is not None:
@@ -210,7 +234,7 @@ def host_op_bytes(laser) -> set:
             continue
         if name == "*":
             return set(range(256))
-        if _replayable_post_hook(name, hooks):
+        if _post_hooks_ok(laser, name):
             continue
         byte = _NAME_TO_BYTE.get(name)
         if byte is not None:
@@ -241,21 +265,33 @@ def tape_replayers_for(laser) -> dict:
     for name, hooks in laser.pre_hooks.items():
         if name not in mapping or not hooks:
             continue
-        if not _replayable_pre_hook(name, hooks) or laser.post_hooks.get(name):
+        if not _replayable_pre_hook(name, hooks) or not _post_hooks_ok(laser, name):
             continue
         for hook in hooks:
             owner = getattr(hook, "__self__", None)
             if owner is not None:
                 out.setdefault(mapping[name], []).append((owner, name))
-    # SSTORE sites replay the RAW skipped pre-hooks (modules and marked
-    # plugin hooks alike) over the recorded event ring
-    sstore_hooks = laser.pre_hooks.get("SSTORE", [])
-    if (
-        sstore_hooks
-        and _replayable_pre_hook("SSTORE", sstore_hooks)
-        and not laser.post_hooks.get("SSTORE")
-    ):
-        out["SSTORE"] = list(sstore_hooks)
+    # SLOAD/SSTORE sites replay the RAW skipped pre-hooks (modules and
+    # marked plugin hooks alike) over the recorded storage event ring
+    for raw_op in ("SSTORE", "SLOAD"):
+        raw_hooks = laser.pre_hooks.get(raw_op, [])
+        if (
+            raw_hooks
+            and _replayable_pre_hook(raw_op, raw_hooks)
+            and _post_hooks_ok(laser, raw_op)
+        ):
+            out[raw_op] = list(raw_hooks)
+    # block-entry tracking (dependency pruner): JUMP/JUMPI post-hooks
+    # marked tape_replay_safe re-fire per reconstructed landing at lift
+    entry_hooks: list = []
+    for jump_op in ("JUMP", "JUMPI"):
+        hooks = laser.post_hooks.get(jump_op, [])
+        if hooks and _replayable_raw_post_hook(jump_op, hooks):
+            for hook in hooks:
+                if hook not in entry_hooks:
+                    entry_hooks.append(hook)
+    if entry_hooks:
+        out["BLOCK_ENTRY"] = entry_hooks
     return out
 
 
@@ -591,7 +627,7 @@ def _suffix_cycle_count(trace: List[int]) -> int:
 
     The host strategy's pair-distance heuristic
     (strategy/extensions/bounded_loops.py) assumes one entry PER
-    INSTRUCTION; the device ring records jumpdests only, so the repeat
+    INSTRUCTION; the device ring records jump landings only, so the repeat
     count is computed directly on suffix periods here."""
     n = len(trace)
     best = 1
@@ -758,6 +794,11 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 pass
             try:
                 resumed = bridge.unpack_lane(out, lane)
+            except PluginSkipState:
+                # block-entry replay pruned the state (dependency pruner:
+                # re-entering this block cannot observe new writes)
+                log.debug("lane %d pruned at lifted block entry", lane)
+                continue
             except Exception as e:  # pragma: no cover - lift bugs surface here
                 log.warning("unpack failed for lane %d: %s", lane, e)
                 continue

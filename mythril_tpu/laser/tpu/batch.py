@@ -46,6 +46,16 @@ class BatchConfig(NamedTuple):
     tape_slots: int = 256  # symbolic term-tape rows per lane
     path_slots: int = 64  # path-condition entries per lane
     mem_sym_slots: int = 16  # 32-byte symbolic memory-overlay words per lane
+    # storage event capacity per lane (SLOADs + SSTOREs): the bridge
+    # re-fires the skipped pre-hooks per recorded event at lift; a lane
+    # exceeding this in one device segment freeze-traps at the
+    # overflowing op. 128 keeps write-heavy loops (the workloads the
+    # batch engine should win on) on device for whole transactions at
+    # ~2KB/lane. Coupled to tape_slots: each DISTINCT concrete key or
+    # value also allocates one OP_CONST tape row (CSE dedupes repeats),
+    # so tape_slots should stay comfortably above the distinct-operand
+    # count a full ring can record.
+    ss_ring: int = 128
 
 
 class CodeBank(NamedTuple):
@@ -63,6 +73,13 @@ class CodeBank(NamedTuple):
     jumpdest: jnp.ndarray  # bool[n_codes, code_len] valid JUMPDEST targets
     host_ops: jnp.ndarray  # bool[256] opcodes that must return to the host
     freeze_errors: jnp.ndarray  # bool[] scalar
+    # record storage events (and freeze-trap on ring overflow, and
+    # allocate CONST nodes for concrete keys/values) only when someone
+    # will replay them: without SLOAD/SSTORE replay hooks the ring is
+    # dead weight, concrete workloads would allocate tape rows for
+    # nothing, and the overflow trap would bounce write-heavy lanes to
+    # the host for no detection benefit (advisor r3)
+    record_storage_events: jnp.ndarray  # bool[] scalar
 
 
 class Env(NamedTuple):
@@ -73,14 +90,11 @@ class Env(NamedTuple):
     run()/mesh plumbing slot for future genuinely-shared context."""
 
 
-# depth of the on-device JUMPDEST ring buffer: bounded-loop detection sees
-# the last JD_RING jumpdests a lane visited (suffix cycles up to ~JD_RING/2)
+# depth of the on-device jump-LANDING ring buffer (where each committed
+# JUMP/JUMPI landed — the host's block-entry stream): feeds bounded-loop
+# suffix-cycle detection and the dependency pruner's entry replay
 JD_RING = 64
 
-# SSTORE event capacity per lane: the bridge re-fires the skipped SSTORE
-# pre-hooks per recorded event at lift time; a lane with more SSTOREs
-# than this freeze-traps at the overflowing SSTORE (exact events matter)
-SS_RING = 16
 
 
 class StateBatch(NamedTuple):
@@ -109,13 +123,15 @@ class StateBatch(NamedTuple):
     balance: jnp.ndarray  # u32[L, 16] self-balance
     steps: jnp.ndarray  # i32[L] instructions retired in this lane
     visited: jnp.ndarray  # bool[L, code_len] byte-pcs retired (coverage)
-    jd_ring: jnp.ndarray  # i32[L, JD_RING] last JUMPDEST byte-pcs (loop bounds)
-    jd_cnt: jnp.ndarray  # i32[L] total JUMPDESTs retired
+    jd_ring: jnp.ndarray  # i32[L, JD_RING] last jump-landing byte-pcs
+    jd_cnt: jnp.ndarray  # i32[L] total jump landings
     jump_cnt: jnp.ndarray  # i32[L] JUMP/JUMPI retired (the host's depth unit)
-    ss_pc: jnp.ndarray  # i32[L, SS_RING] byte pc of each device-retired SSTORE
-    ss_key: jnp.ndarray  # i32[L, SS_RING] key tape tag (0 = concrete key)
-    ss_val: jnp.ndarray  # i32[L, SS_RING] value tape tag (0 = concrete value)
-    ss_cnt: jnp.ndarray  # i32[L] SSTOREs retired on device
+    ss_pc: jnp.ndarray  # i32[L, ss_ring] byte pc of each storage event
+    ss_key: jnp.ndarray  # i32[L, ss_ring] key tape id (CONST node if concrete)
+    ss_val: jnp.ndarray  # i32[L, ss_ring] SSTORE value tape id (0 for loads)
+    ss_is_load: jnp.ndarray  # bool[L, ss_ring] SLOAD (True) vs SSTORE
+    ss_jd: jnp.ndarray  # i32[L, ss_ring] landing count when the event fired
+    ss_cnt: jnp.ndarray  # i32[L] storage events retired on device
     # ---- symbolic layer (laser/tpu/symtape.py). Tags are 1-based tape
     # ids; 0 = concrete (the word/byte planes are authoritative).
     stack_sym: jnp.ndarray  # i32[L, S]
@@ -187,9 +203,11 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "jd_ring": ((L, JD_RING), np.int32),
         "jd_cnt": ((L,), np.int32),
         "jump_cnt": ((L,), np.int32),
-        "ss_pc": ((L, SS_RING), np.int32),
-        "ss_key": ((L, SS_RING), np.int32),
-        "ss_val": ((L, SS_RING), np.int32),
+        "ss_pc": ((L, cfg.ss_ring), np.int32),
+        "ss_key": ((L, cfg.ss_ring), np.int32),
+        "ss_val": ((L, cfg.ss_ring), np.int32),
+        "ss_is_load": ((L, cfg.ss_ring), np.bool_),
+        "ss_jd": ((L, cfg.ss_ring), np.int32),
         "ss_cnt": ((L,), np.int32),
         "stack_sym": ((L, S), np.int32),
         "tape_op": ((L, T), np.int32),
@@ -229,7 +247,10 @@ def empty_batch(cfg: BatchConfig) -> StateBatch:
     )
 
 
-def make_code_bank(codes, code_len: int, host_ops=None, freeze_errors=False) -> CodeBank:
+def make_code_bank(
+    codes, code_len: int, host_ops=None, freeze_errors=False,
+    record_storage_events=False,
+) -> CodeBank:
     """Host helper: list of bytes objects -> CodeBank (pads / analyses).
 
     ``host_ops`` is an optional iterable of opcode bytes that must
@@ -267,6 +288,7 @@ def make_code_bank(codes, code_len: int, host_ops=None, freeze_errors=False) -> 
         jnp.asarray(jd),
         jnp.asarray(hops),
         jnp.asarray(bool(freeze_errors)),
+        jnp.asarray(bool(record_storage_events)),
     )
 
 
@@ -358,6 +380,8 @@ def _fill_lane(
     np_batch["ss_pc"][lane] = 0
     np_batch["ss_key"][lane] = 0
     np_batch["ss_val"][lane] = 0
+    np_batch["ss_is_load"][lane] = False
+    np_batch["ss_jd"][lane] = 0
     np_batch["ss_cnt"][lane] = 0
     # symbolic layer resets
     for f in (

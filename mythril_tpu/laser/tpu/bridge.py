@@ -207,6 +207,10 @@ class DeviceBridge:
             self.cfg.code_len,
             host_ops=self.host_ops,
             freeze_errors=self.freeze_errors,
+            record_storage_events=bool(
+                self.tape_replayers.get("SSTORE")
+                or self.tape_replayers.get("SLOAD")
+            ),
         )
         st = transfer.batch_to_device(self._np_batch, self.cfg)
         return cb, st
@@ -581,6 +585,8 @@ class DeviceBridge:
                 )
             if op == symtape.OP_OPAQUE:
                 v = BitVec(self.opaque[imm_int])
+            elif op == symtape.OP_CONST:
+                v = symbol_factory.BitVecVal(imm_int, 256)
             elif op == symtape.OP_CDLOAD:
                 off = x if int(aa[i]) > 0 else imm_int
                 off = off.value if isinstance(off, BitVec) and not off.symbolic else off
@@ -936,8 +942,8 @@ class DeviceBridge:
         # bounds device-explored paths exactly like host-explored ones
         gs.mstate.depth += int(np.asarray(st.jump_cnt)[lane])
 
-        # JUMPDESTs retired on device extend the per-state jumpdest trace,
-        # so BoundedLoopsStrategy bounds device-explored loops too. The
+        # jump landings retired on device extend the per-state trace, so
+        # BoundedLoopsStrategy bounds device-explored loops too. The
         # device keeps the last JD_RING entries — the suffix is exactly
         # what the repeating-cycle detector inspects.
         jd_cnt = int(np.asarray(st.jd_cnt)[lane])
@@ -963,31 +969,53 @@ class DeviceBridge:
             gs.world_state.constraints.append(cond)
 
         self._replay_jumpi_sites(gs, st, lane, values)
-        self._replay_sstore_sites(gs, st, lane, values)
+        self._replay_segment_sites(gs, st, lane, values)
         return gs
 
-    def _replay_sstore_sites(self, gs, st, lane, values) -> None:
-        """Re-fire the skipped SSTORE pre-hooks for every SSTORE the
-        device retired on this lane (recorded in the ss_* event ring).
+    def _replay_segment_sites(self, gs, st, lane, values) -> None:
+        """Re-fire the skipped site hooks for this lane's device segment
+        in EXACT execution order: block entries (JUMP/JUMPI post-hooks,
+        from the jump-landing ring) interleaved with storage events
+        (SLOAD/SSTORE pre-hooks, from the event ring — each event
+        carries the landing count at which it fired). Keys and values
+        lift exactly: concrete operands ride as CONST tape nodes.
 
-        Same mutate-and-restore site synthesis as the JUMPI replay: pc at
-        the SSTORE, ``[value, key]`` on top of the stack. Concrete keys
-        and values appear as zero-valued words — every replayed hook is
-        annotation- or constraint-based on SYMBOLIC operands (a concrete
-        key makes arbitrary-write's sentinel constraint unsatisfiable and
-        a concrete value cannot carry hazard annotations), so the
-        placeholders are behavior-preserving."""
-        hooks = self.tape_replayers.get("SSTORE")
-        if not hooks:
+        Ring overflow makes the order unreconstructable: entry hooks
+        offering an on_device_overflow callback are told (the dependency
+        pruner disables itself — sound, just slower), storage events
+        cannot have been lost (ss overflow freeze-traps the lane), and
+        the surviving events replay uninterleaved.
+
+        PluginSkipState raised by an entry hook propagates: the caller
+        drops the lifted state, mirroring the host pruner's
+        skip-at-entry. Events before the prune point have replayed;
+        later ones have not — exactly the host's stop-at-entry."""
+        entry_hooks = self.tape_replayers.get("BLOCK_ENTRY") or ()
+        sstore_hooks = self.tape_replayers.get("SSTORE") or ()
+        sload_hooks = self.tape_replayers.get("SLOAD") or ()
+        if not (entry_hooks or sstore_hooks or sload_hooks):
             return
-        count = int(np.asarray(st.ss_cnt)[lane])
-        if count == 0:
-            return
-        ss_pc = np.asarray(st.ss_pc)[lane]
-        ss_key = np.asarray(st.ss_key)[lane]
-        ss_val = np.asarray(st.ss_val)[lane]
-        instr_list = gs.environment.code.instruction_list
-        saved_pc, saved_stack = gs.mstate.pc, gs.mstate.stack
+        from mythril_tpu.laser.tpu.batch import JD_RING
+
+        jd_cnt = int(np.asarray(st.jd_cnt)[lane])
+        overflowed = jd_cnt > JD_RING
+        if overflowed:
+            for hook in entry_hooks:
+                overflow_cb = getattr(hook, "on_device_overflow", None)
+                if overflow_cb is not None:
+                    overflow_cb()
+            landings = []
+        else:
+            ring = np.asarray(st.jd_ring)[lane]
+            landings = [int(ring[k]) for k in range(jd_cnt)]
+
+        ev_cnt = int(np.asarray(st.ss_cnt)[lane])
+        ev_pc = np.asarray(st.ss_pc)[lane]
+        ev_key = np.asarray(st.ss_key)[lane]
+        ev_val = np.asarray(st.ss_val)[lane]
+        ev_is_load = np.asarray(st.ss_is_load)[lane]
+        ev_jd = np.asarray(st.ss_jd)[lane]
+
         zero = symbol_factory.BitVecVal(0, 256)
 
         def term(tag):
@@ -995,23 +1023,48 @@ class DeviceBridge:
                 return values[tag - 1]
             return zero
 
-        try:
-            for j in range(min(count, ss_pc.shape[0])):
-                pc_index = evm_util.get_instruction_index(
-                    instr_list, int(ss_pc[j])
-                )
-                if pc_index is None:
-                    continue
-                gs.mstate.pc = pc_index
+        instr_list = gs.environment.code.instruction_list
+        saved_pc, saved_stack = gs.mstate.pc, gs.mstate.stack
+
+        def fire_storage(j: int) -> None:
+            pc_index = evm_util.get_instruction_index(instr_list, int(ev_pc[j]))
+            if pc_index is None:
+                return
+            gs.mstate.pc = pc_index
+            if ev_is_load[j]:
+                hooks = sload_hooks
+                gs.mstate.stack = MachineStack([term(int(ev_key[j]))])
+            else:
+                hooks = sstore_hooks
                 gs.mstate.stack = MachineStack(
-                    [term(int(ss_val[j])), term(int(ss_key[j]))]
+                    [term(int(ev_val[j])), term(int(ev_key[j]))]
                 )
-                with forced_hook_phase(prehook=True):
-                    for hook in hooks:
-                        try:
-                            hook(gs)
-                        except Exception as e:  # pragma: no cover
-                            log.warning("SSTORE replay failed: %s", e)
+            with forced_hook_phase(prehook=True):
+                for hook in hooks:
+                    try:
+                        hook(gs)
+                    except Exception as e:  # pragma: no cover
+                        log.warning("storage event replay failed: %s", e)
+
+        def fire_entry(landing: int) -> None:
+            pc_index = evm_util.get_instruction_index(instr_list, landing)
+            if pc_index is None:
+                return
+            gs.mstate.pc = pc_index
+            with forced_hook_phase(prehook=False):
+                for hook in entry_hooks:
+                    hook(gs)
+
+        event_j = 0
+        try:
+            for k, landing in enumerate(landings):
+                while event_j < ev_cnt and int(ev_jd[event_j]) <= k:
+                    fire_storage(event_j)
+                    event_j += 1
+                fire_entry(landing)
+            while event_j < ev_cnt:
+                fire_storage(event_j)
+                event_j += 1
         finally:
             gs.mstate.pc = saved_pc
             gs.mstate.stack = saved_stack

@@ -598,24 +598,62 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         st.storage_used[lane, store_slot] | do_store
     )
 
-    # SSTORE event ring: the bridge re-fires the skipped SSTORE pre-hooks
-    # per recorded event at lift time; overflow freeze-traps (exact events
-    # matter to the replayed detection hooks)
+    # Storage event ring: every committed SLOAD and SSTORE records
+    # (pc, key id, value id, is_load, jump count) so the bridge can
+    # re-fire the skipped storage pre-hooks — and the dependency
+    # pruner's block-entry bookkeeping — in EXACT execution order at
+    # lift time. Concrete keys/values ride as CONST tape nodes so the
+    # replayed hooks see exact words (key aliasing for the pruner, the
+    # arbitrary-write sentinel, constant-operand hazards), not zero
+    # placeholders. Overflow freeze-traps: exact events matter.
+    ev_sload = (
+        ok_lane
+        & is_sload
+        & ~storage_trap
+        & ~sym_key_trap
+        & ~storage_alias_trap
+    )
+    ev_base = (ev_sload | (do_store & is_sstore)) & cb.record_storage_events
+    const_key_mask = ev_base & ~has_a
+    tapes, key_const_id, key_const_ok = symtape.alloc(
+        tapes,
+        const_key_mask,
+        jnp.full((L,), symtape.OP_CONST, I32),
+        jnp.full((L,), symtape.ARG_IMM, I32),
+        zero,
+        a,
+        alloc_meta,
+    )
+    const_val_mask = ev_base & is_sstore & ~has_b
+    tapes, val_const_id, val_const_ok = symtape.alloc(
+        tapes,
+        const_val_mask,
+        jnp.full((L,), symtape.OP_CONST, I32),
+        jnp.full((L,), symtape.ARG_IMM, I32),
+        zero,
+        b,
+        alloc_meta,
+    )
+    const_ok = key_const_ok & val_const_ok
+    ev_key_id = jnp.where(has_a, sym_a, key_const_id)
+    ev_val_id = jnp.where(is_sstore, jnp.where(has_b, sym_b, val_const_id), 0)
+
     SSR = st.ss_pc.shape[1]
-    sstore_event = do_store & is_sstore
-    ss_full_trap = is_sstore & ~storage_trap & ~sym_key_trap & (st.ss_cnt >= SSR)
-    sstore_event = sstore_event & ~ss_full_trap
+    ss_full_trap = ev_base & (st.ss_cnt >= SSR)
+    storage_event = ev_base & ~ss_full_trap
     ss_widx = jnp.clip(st.ss_cnt, 0, SSR - 1)
 
     def ss_put(plane, val):
         return plane.at[lane, ss_widx].set(
-            jnp.where(sstore_event, val, plane[lane, ss_widx])
+            jnp.where(storage_event, val, plane[lane, ss_widx])
         )
 
     new_ss_pc = ss_put(st.ss_pc, st.pc)
-    new_ss_key = ss_put(st.ss_key, write_key_sym)
-    new_ss_val = ss_put(st.ss_val, jnp.where(has_b, sym_b, 0))
-    new_ss_cnt = st.ss_cnt + sstore_event.astype(I32)
+    new_ss_key = ss_put(st.ss_key, ev_key_id)
+    new_ss_val = ss_put(st.ss_val, ev_val_id)
+    new_ss_is_load = ss_put(st.ss_is_load, is_sload)
+    new_ss_jd = ss_put(st.ss_jd, st.jd_cnt)
+    new_ss_cnt = st.ss_cnt + storage_event.astype(I32)
 
     # ------------------------------------------------------------------
     # SHA3 (memory slice -> keccak, under cond)
@@ -778,7 +816,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
 
     # ------------------------------------------------------------------
     # status resolution (order matters)
-    alloc_trap = ~(alu_ok & cdload_ok & sload_ok & sha_ok & env_ok)
+    alloc_trap = ~(alu_ok & cdload_ok & sload_ok & sha_ok & env_ok & const_ok)
     sym_trap = (
         jump_dest_sym_trap
         | (modal & (has_a | has_b | has_c))
@@ -997,14 +1035,19 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         balance=st.balance,
         steps=merge(st.steps + 1, st.steps),
         visited=st.visited.at[lane, jnp.clip(st.pc, 0, CL - 1)].max(committed),
+        # jump-LANDING ring: every committed JUMP/JUMPI appends where it
+        # lands (taken dest, or fall-through pc+1 — forked children get
+        # their taken dest patched below). This is the host's block-entry
+        # stream (JUMP/JUMPI post-hooks), feeding both the loop-bound
+        # trace and the dependency pruner's replayed entry bookkeeping.
         jd_ring=st.jd_ring.at[lane, st.jd_cnt % JD_RING].set(
             jnp.where(
-                committed & (op == 0x5B),
-                st.pc,
+                committed & (is_jump | is_jumpi),
+                new_pc,
                 st.jd_ring[lane, st.jd_cnt % JD_RING],
             )
         ),
-        jd_cnt=st.jd_cnt + (committed & (op == 0x5B)),
+        jd_cnt=st.jd_cnt + (committed & (is_jump | is_jumpi)),
         # the host increments mstate.depth once per JUMP/JUMPI evaluated
         # (instructions.py jump_/jumpi_), NOT per instruction — mirror
         # that unit so --max-depth means the same thing on either path
@@ -1013,6 +1056,8 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         ss_pc=merge(new_ss_pc, st.ss_pc),
         ss_key=merge(new_ss_key, st.ss_key),
         ss_val=merge(new_ss_val, st.ss_val),
+        ss_is_load=merge(new_ss_is_load, st.ss_is_load),
+        ss_jd=merge(new_ss_jd, st.ss_jd),
         ss_cnt=merge(new_ss_cnt, st.ss_cnt),
         stack_sym=merge(stack_sym_after, st.stack_sym),
         # tape planes commit unconditionally: rows were written by masked
@@ -1075,10 +1120,16 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         fst = jax.tree_util.tree_map(take, nst)
         dest_g = dest32[src_map]
         plen_idx = jnp.clip(fst.path_len - 1, 0, P - 1)
+        # the copied landing ring holds the parent's fall-through entry;
+        # the child landed on the taken destination instead
+        ring_idx = (fst.jd_cnt - 1) % JD_RING
         return fst._replace(
             pc=jnp.where(child_mask, dest_g, fst.pc),
             path_sign=fst.path_sign.at[lane, plen_idx].set(
                 jnp.where(child_mask, True, fst.path_sign[lane, plen_idx])
+            ),
+            jd_ring=fst.jd_ring.at[lane, ring_idx].set(
+                jnp.where(child_mask, dest_g, fst.jd_ring[lane, ring_idx])
             ),
         )
 

@@ -84,6 +84,10 @@ OP_CHAINID = 40
 OP_BASEFEE = 41
 OP_GASPRICE = 42
 OP_BLOCKHASH = 43  # a = queried block number (ref or ARG_IMM)
+# a concrete 256-bit constant (imm): storage-event records reference
+# concrete keys/values through CONST nodes so replayed detection hooks
+# see EXACT words, not zero placeholders; CSE dedupes repeats
+OP_CONST = 44
 
 # EVM opcode byte -> (tape op, arity); 0 = this opcode never allocates.
 SYM_OP = np.zeros(256, dtype=np.int32)

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Measure the BASELINE.md driver-defined configs: host (bfs, the
+reference's architecture) vs tpu-batch (the flagship mode), SWC parity
+asserted per row.
+
+Writes one JSON object per row to stdout and a summary table to stderr;
+paste the table into BASELINE.md. Run on TPU when the tunnel is alive
+(the script reuses bench.py's killable-subprocess probe + CPU fallback),
+on CPU otherwise — the "platform" field records which.
+
+Usage: python scripts/measure_baseline.py [--budget SECONDS] [--rows a,b]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CORPUS = "/root/reference/tests/testdata/inputs"
+
+# row name -> (sources, tx count, expected SWC ids that must appear)
+ROWS = {
+    "token_t2": ([("asm", "bench_contracts/token.asm")], 2, {"101"}),
+    "suicide_origin_t3": (
+        [("hex", CORPUS + "/suicide.sol.o"), ("hex", CORPUS + "/origin.sol.o")],
+        3,
+        {"106", "115"},
+    ),
+    "bectoken_t3": ([("asm", "bench_contracts/bectoken.asm")], 3, {"101"}),
+    "multiowner_t4": ([("asm", "bench_contracts/multiowner.asm")], 4, {"106"}),
+    "corpus_t2": (
+        [
+            ("hex", os.path.join(CORPUS, name))
+            for name in (
+                sorted(os.listdir(CORPUS)) if os.path.isdir(CORPUS) else []
+            )
+            if name.endswith(".sol.o")
+        ],
+        2,
+        {"101", "104", "105", "106", "107", "110", "112", "115"},
+    ),
+}
+
+
+def _load(kind: str, path: str):
+    from mythril_tpu.disassembler.asm import assemble
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    path = os.path.join(REPO, path) if not os.path.isabs(path) else path
+    name = os.path.basename(path)
+    if kind == "asm":
+        runtime = assemble(open(path).read()).hex()
+        n = len(runtime) // 2
+        creation = (
+            assemble(
+                f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+                "PUSH1 0x00\nRETURN\ncode:"
+            ).hex()
+            + runtime
+        )
+        return EVMContract(code=runtime, creation_code=creation, name=name)
+    return EVMContract(code=open(path).read().strip(), name=name)
+
+
+def _run(contracts, tx: int, strategy: str, budget: int):
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    import mythril_tpu.laser.tpu.backend as backend
+
+    swcs = set()
+    states = 0
+    solver_queries = 0
+    t0 = time.time()
+    for contract in contracts:
+        sym = SymExecWrapper(
+            contract,
+            address=0x1234,
+            strategy=strategy,
+            execution_timeout=budget,
+            transaction_count=tx,
+            max_depth=128,
+        )
+        for issue in fire_lasers(sym):
+            swcs.update(issue.swc_id.split())
+        states += sym.laser.total_states
+        strat = backend.find_tpu_strategy(sym.laser.strategy)
+        if strat is not None:
+            states += strat.device_steps_retired
+    wall = time.time() - t0
+    return {
+        "wall_s": round(wall, 1),
+        "states": states,
+        "states_per_s": round(states / max(wall, 1e-9), 1),
+        "swcs": sorted(swcs),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--budget", type=int, default=120)
+    parser.add_argument("--rows", type=str, default=",".join(ROWS))
+    args = parser.parse_args()
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    bench._probe_backend()
+
+    import jax
+    import mythril_tpu.laser.tpu.backend as backend
+
+    platform = jax.devices()[0].platform
+    # measure throughput, not XLA compile latency
+    backend.warmup_device(backend.DEFAULT_BATCH_CFG)
+
+    results = {}
+    for row in args.rows.split(","):
+        sources, tx, expected = ROWS[row]
+        contracts = [_load(kind, path) for kind, path in sources]
+        if not contracts:
+            print(f"{row}: no inputs found, skipped", file=sys.stderr)
+            continue
+        host = _run(contracts, tx, "bfs", args.budget)
+        dev = _run(contracts, tx, "tpu-batch", args.budget)
+        parity = set(host["swcs"]) == set(dev["swcs"])
+        found = expected <= set(dev["swcs"])
+        results[row] = {
+            "platform": platform,
+            "tx": tx,
+            "host": host,
+            "tpu_batch": dev,
+            "integrated_vs_host": round(
+                dev["states_per_s"] / max(host["states_per_s"], 1e-9), 2
+            ),
+            "swc_parity": parity,
+            "expected_found": found,
+        }
+        print(json.dumps({row: results[row]}), flush=True)
+        status = "OK" if parity and found else "MISMATCH"
+        print(
+            f"{row:>20}  host {host['states_per_s']:>8}/s  "
+            f"tpu-batch {dev['states_per_s']:>8}/s  "
+            f"x{results[row]['integrated_vs_host']:<6} {status}",
+            file=sys.stderr,
+        )
+    out = os.path.join(REPO, "BASELINE_MEASURED.json")
+    with open(out, "w") as fh:
+        json.dump(results, fh, indent=1)
+    print(f"wrote {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

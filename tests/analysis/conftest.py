@@ -1,0 +1,76 @@
+"""Shared harness for the analysis-pipeline tests: one small batch
+config, one creation-shim builder, one analyze() runner — so a
+BatchConfig field or shim change happens in exactly one place."""
+
+import pytest
+
+import mythril_tpu.laser.tpu.backend as backend
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.laser.tpu.batch import BatchConfig
+
+# small lanes keep CPU compile time down; one shared config = one compile
+SMALL_BATCH_CFG = BatchConfig(
+    lanes=32,
+    stack_slots=16,
+    memory_bytes=256,
+    calldata_bytes=128,
+    storage_slots=8,
+    code_len=512,
+    tape_slots=64,
+    path_slots=16,
+    mem_sym_slots=8,
+)
+
+
+@pytest.fixture
+def small_batch(monkeypatch):
+    monkeypatch.setattr(backend, "DEFAULT_BATCH_CFG", SMALL_BATCH_CFG)
+
+
+def make_contract(runtime_src: str, name: str = "T") -> EVMContract:
+    """Assemble runtime source and wrap it in a CODECOPY/RETURN deployer."""
+    runtime = assemble(runtime_src).hex()
+    n = len(runtime) // 2
+    creation = (
+        assemble(
+            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+            "PUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + runtime
+    )
+    return EVMContract(code=runtime, creation_code=creation, name=name)
+
+
+def analyze_contract(
+    runtime_src: str,
+    modules,
+    strategy: str = "tpu-batch",
+    tx: int = 1,
+    timeout: int = 240,
+    max_depth: int = 64,
+    **wrapper_kwargs,
+):
+    """Full pipeline on an assembled contract; returns
+    (issues, SymExecWrapper, TpuBatchStrategy-or-None)."""
+    sym = SymExecWrapper(
+        make_contract(runtime_src),
+        address=0x1234,
+        strategy=strategy,
+        execution_timeout=timeout,
+        transaction_count=tx,
+        max_depth=max_depth,
+        modules=modules,
+        **wrapper_kwargs,
+    )
+    issues = fire_lasers(sym, modules)
+    return issues, sym, backend.find_tpu_strategy(sym.laser.strategy)
+
+
+def swc_set(issues) -> set:
+    out = set()
+    for issue in issues:
+        out.update(issue.swc_id.split())
+    return out

@@ -9,57 +9,13 @@ device-dominant with unchanged findings (reference behavior surface:
 mythril/analysis/modules/dependence_on_predictable_vars.py).
 """
 
-import numpy as np
 import pytest
 
 import mythril_tpu.laser.tpu.backend as backend
-from mythril_tpu.analysis.security import fire_lasers
-from mythril_tpu.analysis.symbolic import SymExecWrapper
-from mythril_tpu.disassembler.asm import assemble
-from mythril_tpu.ethereum.evmcontract import EVMContract
-from mythril_tpu.laser.tpu.batch import BatchConfig
 
-TEST_CFG = BatchConfig(
-    lanes=32,
-    stack_slots=16,
-    memory_bytes=256,
-    calldata_bytes=128,
-    storage_slots=8,
-    code_len=512,
-    tape_slots=64,
-    path_slots=16,
-    mem_sym_slots=8,
-)
+from tests.analysis.conftest import analyze_contract, swc_set
 
-
-@pytest.fixture(autouse=True)
-def small_batch(monkeypatch):
-    monkeypatch.setattr(backend, "DEFAULT_BATCH_CFG", TEST_CFG)
-
-
-def analyze(runtime_src: str, modules, strategy="tpu-batch", tx=1):
-    runtime = assemble(runtime_src).hex()
-    n = len(runtime) // 2
-    creation = (
-        assemble(
-            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
-            "PUSH1 0x00\nRETURN\ncode:"
-        ).hex()
-        + runtime
-    )
-    contract = EVMContract(code=runtime, creation_code=creation, name="T")
-    sym = SymExecWrapper(
-        contract,
-        address=0x1234,
-        strategy=strategy,
-        execution_timeout=240,
-        transaction_count=tx,
-        max_depth=64,
-        modules=modules,
-    )
-    issues = fire_lasers(sym, modules)
-    strategy_obj = backend.find_tpu_strategy(sym.laser.strategy)
-    return issues, sym, strategy_obj
+pytestmark = pytest.mark.usefixtures("small_batch")
 
 
 # branch on block.timestamp & 7 — the SWC-116 shape
@@ -105,27 +61,26 @@ STOP
 """
 
 
-def swc_set(issues):
-    out = set()
-    for issue in issues:
-        out.update(issue.swc_id.split())
-    return out
-
-
 def test_timestamp_retires_on_device_with_swc116():
-    issues, _sym, strategy = analyze(TIMESTAMP_SRC, ["PredictableVariables"])
+    issues, _sym, strategy = analyze_contract(
+        TIMESTAMP_SRC, ["PredictableVariables"]
+    )
     assert "116" in swc_set(issues)
     assert strategy.device_steps_retired > 0
 
 
 def test_number_retires_on_device_with_swc120():
-    issues, _sym, strategy = analyze(NUMBER_SRC, ["PredictableVariables"])
+    issues, _sym, strategy = analyze_contract(
+        NUMBER_SRC, ["PredictableVariables"]
+    )
     assert "120" in swc_set(issues)
     assert strategy.device_steps_retired > 0
 
 
 def test_stale_blockhash_on_device_swc120():
-    issues, _sym, strategy = analyze(BLOCKHASH_SRC, ["PredictableVariables"])
+    issues, _sym, strategy = analyze_contract(
+        BLOCKHASH_SRC, ["PredictableVariables"]
+    )
     assert "120" in swc_set(issues)
     assert strategy.device_steps_retired > 0
 
@@ -133,7 +88,7 @@ def test_stale_blockhash_on_device_swc120():
 def test_block_ops_not_in_trap_set():
     """With only batch-aware hookers loaded, the whole block-env family
     retires on device instead of freeze-trapping per read."""
-    _issues, sym, _strategy = analyze(
+    _issues, sym, _strategy = analyze_contract(
         TIMESTAMP_SRC, ["PredictableVariables", "TxOrigin"]
     )
     hooked = backend.host_op_bytes(sym.laser)
@@ -143,7 +98,9 @@ def test_block_ops_not_in_trap_set():
 
 def test_host_device_parity_on_block_env():
     for src, swc in ((TIMESTAMP_SRC, "116"), (NUMBER_SRC, "120")):
-        host_issues, _s, _t = analyze(src, ["PredictableVariables"], strategy="bfs")
-        dev_issues, _s, _t = analyze(src, ["PredictableVariables"])
+        host_issues, _s, _t = analyze_contract(
+            src, ["PredictableVariables"], strategy="bfs"
+        )
+        dev_issues, _s, _t = analyze_contract(src, ["PredictableVariables"])
         assert swc_set(host_issues) == swc_set(dev_issues)
         assert swc in swc_set(dev_issues)

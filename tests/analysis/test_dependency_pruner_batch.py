@@ -1,0 +1,108 @@
+"""Dependency pruner under tpu-batch (VERDICT r3 #4).
+
+The reference's biggest multi-tx state-explosion killer
+(mythril/laser/ethereum/plugins/implementations/dependency_pruner.py)
+used to be disabled exactly in the flagship mode because its JUMP/JUMPI
+post-hooks and SLOAD/SSTORE pre-hooks would freeze-trap the device at
+every branch. Its hooks are now batch-aware: storage records replay
+from the ordered event ring (concrete keys/values exact via CONST tape
+nodes), block entries from the jump-landing ring, and the prune
+decision applies at lift (PluginSkipState drops the lane).
+"""
+
+import pytest
+
+import mythril_tpu.laser.tpu.backend as backend
+
+from tests.analysis.conftest import analyze_contract, swc_set
+
+pytestmark = pytest.mark.usefixtures("small_batch")
+
+
+# tx1: store calldata flag to slot 5. tx2: SELFDESTRUCT only if slot 5 == 1.
+# The reading block must survive pruning for the SWC-106 witness to exist.
+# The NON-ZERO concrete slot pins the exact-key replay: device-retired
+# SSTOREs record their concrete key through a CONST tape node — a zero
+# placeholder here would make the pruner's write cache miss slot 5 and
+# prune the reading block (review r4 finding).
+GATED_SUICIDE_SRC = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH1 0x05
+SSTORE
+PUSH1 0x05
+SLOAD
+PUSH1 0x01
+EQ
+PUSH1 :kill
+JUMPI
+STOP
+kill:
+JUMPDEST
+CALLER
+SELFDESTRUCT
+"""
+
+# a storage-free branchy contract: repeat block entries across
+# transactions observe nothing, so the pruner should cut the state count
+PURE_BRANCHES_SRC = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH1 :a
+JUMPI
+STOP
+a:
+JUMPDEST
+PUSH1 0x20
+CALLDATALOAD
+PUSH1 :b
+JUMPI
+STOP
+b:
+JUMPDEST
+STOP
+"""
+
+
+def analyze(src, modules, strategy="tpu-batch", tx=2, prune=True):
+    return analyze_contract(
+        src,
+        modules,
+        strategy=strategy,
+        tx=tx,
+        disable_dependency_pruning=not prune,
+    )
+
+
+def test_pruner_loaded_and_device_still_retires():
+    """The guard is gone: with the pruner loaded, JUMPI/SLOAD/SSTORE
+    still retire on device (its hooks are replayable, not trapping)."""
+    _issues, sym, strategy = analyze(GATED_SUICIDE_SRC, ["AccidentallyKillable"])
+    hooked = backend.host_op_bytes(sym.laser)
+    assert 0x54 not in hooked  # SLOAD
+    assert 0x55 not in hooked  # SSTORE
+    assert 0x56 not in hooked  # JUMP
+    assert 0x57 not in hooked  # JUMPI
+    assert strategy.device_steps_retired > 0
+
+
+def test_pruner_preserves_cross_tx_detection():
+    """Pruning must not drop the storage-gated SWC-106 path: the block
+    reading slot 5 observes tx1's write and survives."""
+    issues, _sym, _strategy = analyze(GATED_SUICIDE_SRC, ["AccidentallyKillable"])
+    assert "106" in swc_set(issues)
+
+
+def test_pruner_matches_host_findings():
+    for modules in (["AccidentallyKillable"],):
+        host_issues, _s, _t = analyze(GATED_SUICIDE_SRC, modules, strategy="bfs")
+        dev_issues, _s, _t = analyze(GATED_SUICIDE_SRC, modules)
+        assert swc_set(host_issues) == swc_set(dev_issues)
+
+
+def test_pruner_cuts_states_on_pure_branches():
+    """On a storage-free contract the pruner skips repeat block entries
+    from transaction 2 on — measurably fewer states than unpruned."""
+    _issues, pruned, _t = analyze(PURE_BRANCHES_SRC, [], tx=3)
+    _issues, unpruned, _t = analyze(PURE_BRANCHES_SRC, [], tx=3, prune=False)
+    assert pruned.laser.total_states < unpruned.laser.total_states
