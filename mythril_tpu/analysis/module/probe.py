@@ -22,7 +22,7 @@ Semantics parity notes:
 
 import logging
 from copy import copy
-from typing import Iterable, List, Optional
+from typing import Iterable, Optional
 
 from mythril_tpu.analysis import solver
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint

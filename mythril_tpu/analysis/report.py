@@ -6,7 +6,7 @@ import hashlib
 import json
 import logging
 import operator
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from mythril_tpu.analysis.swc_data import SWC_TO_TITLE
 from mythril_tpu.support.source_support import Source

@@ -15,7 +15,7 @@ Parity surface: mythril/analysis/solver.py — two entry points:
 
 import logging
 from functools import lru_cache
-from typing import Dict, List, Tuple, Union
+from typing import Dict, List
 
 from mythril_tpu.analysis.analysis_args import analysis_args
 from mythril_tpu.exceptions import UnsatError

@@ -17,7 +17,7 @@ class MythrilLevelDB:
 
         try:
             self.leveldb_db.search(search, search_callback)
-        except SyntaxError:
+        except (SyntaxError, re.error):
             raise CriticalError("Syntax error in search expression.")
 
     def contract_hash_to_address(self, contract_hash: str) -> None:

@@ -153,19 +153,19 @@ def _load_code(args, disassembler) -> str:
 # ------------------------------------------------------------------ commands
 
 
-def run_analyze(args) -> None:
+def _make_analyzer(source, args, address=None, use_onchain_data=False):
+    """Shared analyze/truffle plumbing: the --lanes batch override plus
+    MythrilAnalyzer construction from the analysis flag group."""
     from mythril_tpu.core.mythril_analyzer import MythrilAnalyzer
 
     if args.lanes:
         import mythril_tpu.laser.tpu.backend as backend
 
-        backend.DEFAULT_BATCH_CFG = backend.DEFAULT_BATCH_CFG._replace(lanes=args.lanes)
-
-    config = _make_config(args)
-    disassembler = _make_disassembler(args, config)
-    address = _load_code(args, disassembler)
-    analyzer = MythrilAnalyzer(
-        disassembler,
+        backend.DEFAULT_BATCH_CFG = backend.DEFAULT_BATCH_CFG._replace(
+            lanes=args.lanes
+        )
+    return MythrilAnalyzer(
+        source,
         strategy=args.strategy,
         address=address,
         max_depth=args.max_depth,
@@ -177,10 +177,13 @@ def run_analyze(args) -> None:
         solver_timeout=args.solver_timeout,
         enable_coverage_strategy=args.enable_coverage_strategy,
         custom_modules_directory=args.custom_modules_directory,
-        use_onchain_data=not args.no_onchain_data,
+        use_onchain_data=use_onchain_data,
         checkpoint_dir=args.checkpoint_dir,
     )
 
+
+def _run_analysis(analyzer, args) -> None:
+    """Shared analysis tail: -g/-j exports or the full detection run."""
     if args.graph:
         html = analyzer.graph_html(transaction_count=args.transaction_count)
         with open(args.graph, "w") as f:
@@ -191,12 +194,24 @@ def run_analyze(args) -> None:
         with open(args.statespace_json, "w") as f:
             f.write(dump)
         return
-
     modules = args.modules.split(",") if args.modules else None
     report = analyzer.fire_lasers(
         modules=modules, transaction_count=args.transaction_count
     )
     emit_report(report, args.outform)
+
+
+def run_analyze(args) -> None:
+    config = _make_config(args)
+    disassembler = _make_disassembler(args, config)
+    address = _load_code(args, disassembler)
+    analyzer = _make_analyzer(
+        disassembler,
+        args,
+        address=address,
+        use_onchain_data=not args.no_onchain_data,
+    )
+    _run_analysis(analyzer, args)
 
 
 def emit_report(report, outform: str) -> None:
@@ -285,6 +300,77 @@ def run_read_storage(args) -> None:
     print(outtxt)
 
 
+def run_leveldb_search(args) -> None:
+    """Regex-search stored contract code in a local geth LevelDB
+    (reference cli.py:247 dispatch + :559 leveldb_search)."""
+    from mythril_tpu.core.mythril_leveldb import MythrilLevelDB
+
+    config = _make_config(args)
+    leveldb_dir = args.leveldb_dir or config.leveldb_dir
+    searcher = MythrilLevelDB(config.set_api_leveldb(leveldb_dir))
+    searcher.search_db(args.search)
+
+
+def run_truffle(args) -> None:
+    """Analyze a truffle project from its build artifacts (reference
+    cli.py:264 subcommand / :386 --truffle flag): reads
+    build/contracts/*.json in the project dir and runs the same
+    analysis pipeline over each deployed contract."""
+    import glob
+
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    project_dir = args.project_dir or os.getcwd()
+    artifacts = sorted(
+        glob.glob(os.path.join(project_dir, "build", "contracts", "*.json"))
+    )
+    if not artifacts:
+        raise CriticalError(
+            "No truffle build artifacts found (expected "
+            "build/contracts/*.json under %r). Run `truffle compile` "
+            "first, or pass --project-dir." % project_dir
+        )
+    contracts = []
+    for path in artifacts:
+        try:
+            with open(path) as fh:
+                artifact = json.load(fh)
+        except (OSError, ValueError) as e:
+            log_msg = "Skipping unreadable artifact %s: %s" % (path, e)
+            logging.getLogger(__name__).warning(log_msg)
+            continue
+        deployed = (artifact.get("deployedBytecode") or "").strip()
+        creation = (artifact.get("bytecode") or "").strip()
+        if deployed in ("", "0x"):
+            continue  # interfaces/abstract contracts have no runtime code
+        contracts.append(
+            EVMContract(
+                code=deployed,
+                creation_code=creation if creation not in ("", "0x") else "",
+                name=artifact.get("contractName") or os.path.basename(path),
+            )
+        )
+    if not contracts:
+        raise CriticalError("No deployable contracts in the truffle artifacts")
+
+    class _TruffleSource:
+        """Duck-typed disassembler facade over the loaded artifacts."""
+
+        eth = None
+        enable_online_lookup = False
+
+        def __init__(self, loaded):
+            self.contracts = loaded
+
+    # same placeholder target address load_from_bytecode uses: artifacts
+    # with runtime code but no creation code take the message-call path,
+    # which needs a concrete callee
+    analyzer = _make_analyzer(
+        _TruffleSource(contracts), args, address="0x" + "0" * 38 + "06"
+    )
+    _run_analysis(analyzer, args)
+
+
 # ------------------------------------------------------------------ registry
 
 COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
@@ -339,6 +425,30 @@ COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
             add_output_flag,
         ],
         run_read_storage,
+    ),
+    "leveldb-search": (
+        "Searches the code fragment in local leveldb",
+        [
+            lambda p: p.add_argument("search", help="regex over contract code"),
+            lambda p: p.add_argument(
+                "--leveldb-dir",
+                help="path to the geth chaindata LevelDB (default from config.ini)",
+            ),
+            add_output_flag,
+        ],
+        run_leveldb_search,
+    ),
+    "truffle": (
+        "Analyze a truffle project from its build artifacts",
+        [
+            lambda p: p.add_argument(
+                "--project-dir",
+                help="truffle project root (default: current directory)",
+            ),
+            add_output_flag,
+            add_analysis_flags,
+        ],
+        run_truffle,
     ),
 }
 

@@ -39,7 +39,6 @@ from mythril_tpu.disassembler.disassembly import Disassembly
 from mythril_tpu.support.opcodes import calculate_sha3_gas, get_opcode_gas
 from mythril_tpu.support.support_utils import get_code_hash
 from mythril_tpu.smt import (
-    And,
     BitVec,
     Bool,
     Concat,
@@ -49,14 +48,11 @@ from mythril_tpu.smt import (
     LShR,
     Not,
     UDiv,
-    UGE,
     UGT,
-    ULE,
     ULT,
     URem,
     SRem,
     is_false,
-    is_true,
     simplify,
     symbol_factory,
 )

@@ -5,12 +5,11 @@ return data instead (call.py)."""
 
 import hashlib
 import logging
-from typing import List, Union
+from typing import List
 
 from mythril_tpu.laser.evm.state.calldata import BaseCalldata, ConcreteCalldata
 from mythril_tpu.laser.evm.util import extract32, extract_copy
 from mythril_tpu.support import crypto
-from mythril_tpu.support.opcodes import ceil32
 
 log = logging.getLogger(__name__)
 

@@ -7,7 +7,7 @@ any frontend)."""
 import json
 import logging
 import time
-from typing import Dict, List
+from typing import Dict
 
 from mythril_tpu.laser.evm.plugins.plugin import LaserPlugin
 

@@ -733,6 +733,12 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             except PackError as e:
                 log.debug("State stays on host path: %s", e)
                 laser.work_list.append(state)
+            except Exception as e:  # pragma: no cover - pack bugs degrade
+                # an unexpected staging failure must not kill the whole
+                # analysis: the state is untouched (stage wipes the lane
+                # on failure), so the host path continues it exactly
+                log.warning("pack failed unexpectedly (%s); host continues", e)
+                laser.work_list.append(state)
         if not packed_states:
             continue
 

@@ -187,8 +187,19 @@ class DeviceBridge:
         n_seeds = len(self.seeds)
         try:
             self.pack_into(self._np_batch, lane, state)
-        except PackError:
+        except Exception:
+            # wipe the lane for ANY failure (not only PackError) so an
+            # unexpected packing bug leaves the bridge consistent and the
+            # caller can keep the state on the host path. Annotations
+            # recorded before the failure must go too: the rolled-back
+            # seed_id is reused by the next staged state, which would
+            # otherwise inherit this state's taints at lift.
             del self.seeds[n_seeds:]
+            self.pack_annotations = {
+                key: val
+                for key, val in self.pack_annotations.items()
+                if key[0] < n_seeds
+            }
             for plane in self._np_batch.values():
                 plane[lane] = 0
             raise
@@ -332,6 +343,15 @@ class DeviceBridge:
         if len(mstate.stack) > self.cfg.stack_slots:
             raise PackError("stack exceeds capacity")
         for i, item in enumerate(mstate.stack):
+            if isinstance(item, Bool):
+                # some host instructions leave raw Bools on the stack
+                # (word-valued on read); pack the 0/1 word form, keeping
+                # the wrapper's annotations for taint flow
+                item = If(
+                    item,
+                    symbol_factory.BitVecVal(1, 256),
+                    symbol_factory.BitVecVal(0, 256),
+                )
             if isinstance(item, int):
                 np_batch["stack"][lane, i] = _word(item)
             elif item.symbolic is False:

@@ -32,7 +32,6 @@ host engine (laser/evm/) resumes it.
 """
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

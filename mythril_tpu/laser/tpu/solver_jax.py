@@ -32,7 +32,6 @@ the VPU. Clause width is fixed at 3 (the Blaster's gate layer emits only
 """
 
 import logging
-from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np

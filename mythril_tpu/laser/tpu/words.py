@@ -19,7 +19,6 @@ SDIV overflow (-2^255 / -1) wraps, EXP is mod 2^256, shifts >= 256 give
 0 (or the sign-fill for SAR).
 """
 
-from functools import partial
 from typing import Tuple
 
 import jax

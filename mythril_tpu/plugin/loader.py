@@ -10,7 +10,7 @@ import logging
 from mythril_tpu.analysis.module.base import DetectionModule
 from mythril_tpu.analysis.module.loader import ModuleLoader
 from mythril_tpu.plugin.discovery import PluginDiscovery
-from mythril_tpu.plugin.interface import MythrilCLIPlugin, MythrilPlugin
+from mythril_tpu.plugin.interface import MythrilPlugin
 from mythril_tpu.support.support_utils import Singleton
 
 log = logging.getLogger(__name__)

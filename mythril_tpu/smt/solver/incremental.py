@@ -21,7 +21,7 @@ from typing import Dict, FrozenSet, List, Optional, Tuple
 
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.solver import pysat
-from mythril_tpu.smt.solver.bitblast import Blaster, BlastError
+from mythril_tpu.smt.solver.bitblast import Blaster
 from mythril_tpu.smt.solver.native import make_sat
 from mythril_tpu.smt.solver.preprocess import TheoryEliminator
 

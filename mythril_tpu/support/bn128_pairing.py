@@ -21,7 +21,7 @@ tests/support/test_bn128_pairing.py (e(P,Q)*e(-P,Q) == 1 etc.) mirroring
 the reference's tests/laser/Precompiles pairing vectors.
 """
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
 R = 21888242871839275222246405745257275088548364400416034343698204186575808495617

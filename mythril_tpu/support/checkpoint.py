@@ -15,7 +15,7 @@ transaction round when loaded (wired to --checkpoint-dir in the CLI).
 import logging
 import os
 import pickle
-from typing import List, Optional
+from typing import List
 
 from mythril_tpu.laser.evm.plugins.plugin import LaserPlugin
 from mythril_tpu.laser.evm.state.world_state import WorldState

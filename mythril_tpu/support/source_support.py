@@ -1,7 +1,6 @@
 """Source bookkeeping for jsonv2 reports (reference surface:
 mythril/support/source_support.py)."""
 
-from mythril_tpu.support.support_utils import get_code_hash
 
 
 class Source:

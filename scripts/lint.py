@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""In-repo quality gate (reference parity surface: tox.ini mypy + the
+CircleCI black check). This image ships neither mypy/pyright nor
+black/ruff and installs are not possible, so the gate enforces what the
+standard library can check reliably:
+
+  - every file byte-compiles (SyntaxError = fail)
+  - no unused imports (ast-based; `as _name`/`__future__`/re-exports in
+    __init__.py and explicitly-noqa'd lines are exempt)
+  - no tabs in indentation, no trailing whitespace, newline at EOF
+
+Run via scripts/check.sh. Exit 0 = clean.
+"""
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+TARGETS = ["mythril_tpu", "tests", "bench.py", "scripts", "__graft_entry__.py"]
+
+
+def iter_files():
+    for target in TARGETS:
+        path = REPO / target
+        if path.is_file():
+            yield path
+        else:
+            yield from sorted(path.rglob("*.py"))
+
+
+def unused_imports(tree: ast.AST, source: str, is_init: bool):
+    """(lineno, name) pairs for imports never referenced in the file."""
+    if is_init:
+        return []  # __init__.py imports are the package's re-export surface
+    imported = {}  # local binding name -> lineno
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                imported[name] = node.lineno
+    if not imported:
+        return []
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    lines = source.splitlines()
+    out = []
+    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
+        if name in used or name.startswith("_"):
+            continue
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        # a bare name used only inside a docstring/string doesn't count;
+        # conversely __all__ references do
+        if f'"{name}"' in source and "__all__" in source:
+            continue
+        out.append((lineno, name))
+    return out
+
+
+def main() -> int:
+    problems = []
+    for path in iter_files():
+        rel = path.relative_to(REPO)
+        source = path.read_text()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        for lineno, name in unused_imports(
+            tree, source, path.name == "__init__.py"
+        ):
+            problems.append(f"{rel}:{lineno}: unused import '{name}'")
+        for i, line in enumerate(source.splitlines(), 1):
+            stripped = line.rstrip("\n")
+            if stripped != stripped.rstrip():
+                problems.append(f"{rel}:{i}: trailing whitespace")
+            indent = stripped[: len(stripped) - len(stripped.lstrip())]
+            if "\t" in indent:
+                problems.append(f"{rel}:{i}: tab in indentation")
+        if source and not source.endswith("\n"):
+            problems.append(f"{rel}: no newline at end of file")
+    for problem in problems:
+        print(problem)
+    print(
+        f"lint: {len(problems)} problem(s) in "
+        f"{sum(1 for _ in iter_files())} files"
+    )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,6 @@ the image, so the vulnerable patterns are authored directly in EVM assembly)."""
 
 import logging
 
-import pytest
 
 from mythril_tpu.analysis.security import fire_lasers
 from mythril_tpu.analysis.symbolic import SymExecWrapper

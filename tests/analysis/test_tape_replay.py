@@ -6,8 +6,6 @@ trapping, so the device retires long segments while detection stays
 exact (VERDICT r2: "make detection modules batch-aware").
 """
 
-import numpy as np
-import pytest
 
 import mythril_tpu.laser.tpu.backend as backend
 from mythril_tpu.analysis.security import fire_lasers

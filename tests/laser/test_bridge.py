@@ -7,7 +7,6 @@ a trapped lane must resume through the host engine and complete.
 """
 
 import numpy as np
-import pytest
 
 from mythril_tpu.disassembler.asm import assemble
 from mythril_tpu.laser.evm.state.calldata import ConcreteCalldata, SymbolicCalldata
@@ -17,15 +16,12 @@ from mythril_tpu.laser.evm.transaction.transaction_models import (
     MessageCallTransaction,
     get_next_transaction_id,
 )
-from mythril_tpu.laser.tpu import symtape
 from mythril_tpu.laser.tpu.batch import (
     BatchConfig,
-    RUNNING,
     STOPPED,
     TRAP,
     default_env,
-    read_storage_full,
-)
+    )
 from mythril_tpu.laser.tpu.bridge import DeviceBridge
 from mythril_tpu.laser.tpu.engine import run
 from mythril_tpu.smt import symbol_factory

@@ -9,16 +9,12 @@ test). Parity model: the reference's concrete interpreter behavior
 """
 
 import numpy as np
-import jax.numpy as jnp
-import pytest
 
 from mythril_tpu.disassembler.asm import assemble
-from mythril_tpu.laser.tpu import words
 from mythril_tpu.laser.tpu.batch import (
     ERROR,
     REVERTED,
     RETURNED,
-    RUNNING,
     STOPPED,
     TRAP,
     BatchConfig,

@@ -8,10 +8,9 @@ byte-for-byte on every input length across block boundaries.
 import random
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
-from mythril_tpu.laser.tpu.keccak_tpu import keccak256_batch, keccak_f
+from mythril_tpu.laser.tpu.keccak_tpu import keccak256_batch
 from mythril_tpu.support.keccak import keccak256
 
 

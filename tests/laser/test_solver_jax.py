@@ -4,11 +4,9 @@ CDCL answer on the same constraint set (SURVEY §7 stage 5 gate)."""
 
 import random
 
-import pytest
 
 from mythril_tpu.laser.tpu import solver_jax as sj
 from mythril_tpu.smt import (
-    And,
     Or,
     Not,
     Solver,

@@ -11,7 +11,6 @@ from mythril_tpu.disassembler.asm import assemble
 from mythril_tpu.laser.tpu import symtape
 from mythril_tpu.laser.tpu.batch import (
     BatchConfig,
-    RUNNING,
     STOPPED,
     TRAP,
     build_batch,

@@ -1,20 +1,14 @@
 import random
 
-import pytest
 
 from mythril_tpu.smt import (
     And,
     Array,
-    BitVec,
-    Bool,
     Concat,
-    Extract,
     Function,
     If,
     K,
-    Not,
     Optimize,
-    Or,
     Solver,
     UGT,
     ULT,
@@ -22,7 +16,6 @@ from mythril_tpu.smt import (
     sat,
     unsat,
 )
-from mythril_tpu.smt import terms
 from mythril_tpu.smt.solver.independence_solver import IndependenceSolver
 
 

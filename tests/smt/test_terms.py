@@ -1,6 +1,5 @@
 import random
 
-import pytest
 
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.terms import EvalEnv, evaluate

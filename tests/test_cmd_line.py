@@ -91,6 +91,64 @@ def test_no_input_error_json():
     assert proc.returncode == 1
 
 
+def test_help_lists_all_subcommands():
+    out = myth("--help").stdout
+    for sub in ("analyze", "disassemble", "pro", "leveldb-search", "truffle",
+                "read-storage", "list-detectors"):
+        assert sub in out, sub
+
+
+def test_leveldb_search_missing_db_errors_cleanly():
+    proc = myth(
+        "leveldb-search", "deadbeef", "--leveldb-dir", "/nonexistent/chaindata"
+    )
+    assert proc.returncode == 1
+    assert "plyvel" in proc.stdout + proc.stderr or "LevelDB" in (
+        proc.stdout + proc.stderr
+    )
+
+
+def test_truffle_analyzes_build_artifacts(tmp_path):
+    import json as _json
+
+    runtime = RUNTIME
+    creation = creation_of(runtime)
+    build = tmp_path / "build" / "contracts"
+    build.mkdir(parents=True)
+    (build / "Killable.json").write_text(
+        _json.dumps(
+            {
+                "contractName": "Killable",
+                "bytecode": "0x" + creation,
+                "deployedBytecode": "0x" + runtime,
+            }
+        )
+    )
+    # an abstract contract without runtime code must be skipped
+    (build / "IEmpty.json").write_text(
+        _json.dumps(
+            {"contractName": "IEmpty", "bytecode": "0x", "deployedBytecode": "0x"}
+        )
+    )
+    # runtime-only artifact (no creation code): must analyze through the
+    # message-call path with the placeholder address, not crash
+    (build / "RuntimeOnly.json").write_text(
+        _json.dumps(
+            {
+                "contractName": "RuntimeOnly",
+                "bytecode": "0x",
+                "deployedBytecode": "0x" + runtime,
+            }
+        )
+    )
+    proc = myth(
+        "truffle", "--project-dir", str(tmp_path),
+        "-t", "1", "--execution-timeout", "300",
+    )
+    assert "SWC ID: 106" in proc.stdout
+    assert "RuntimeOnly" in proc.stdout
+
+
 def test_analyze_bytecode_text():
     proc = myth(
         "analyze",
