@@ -203,7 +203,14 @@ class IntegerArithmetics(DetectionModule):
 
     def replay_tape_node(self, origin, opcode: str, lhs, rhs) -> None:
         """Batch-aware form of the arithmetic pre-hooks (see
-        tape_replay_hooks): identical tagging over lifted operand terms."""
+        tape_replay_hooks): identical tagging over lifted operand terms.
+
+        Accepted approximation: FULLY concrete arithmetic allocates no
+        tape node on device (the result constant-folds), so a
+        literal-operand overflow (e.g. PUSH max PUSH 1 ADD) that the
+        host pre-hook would tag is not replayed. Solidity's optimizer
+        folds such constants away before deployment, so real bytecode
+        reaches this only through hand-written corner cases."""
         if lhs is None or rhs is None:
             return
         self._tag_operands(origin, opcode, lhs, rhs)

@@ -1,7 +1,9 @@
 """Thin LevelDB handle (parity: mythril/ethereum/interface/leveldb/eth_db.py).
 
-The C++ LevelDB binding (`plyvel`) is an optional dependency; importing
-this module without it raises a clear error only when actually used.
+The C++ LevelDB binding (`plyvel`) is preferred when present; without it
+the pure-Python on-disk-format reader (pyleveldb.py) serves read paths
+— uncompacted databases fully, compacted ones with a clear error
+pointing at plyvel.
 """
 
 try:
@@ -15,20 +17,35 @@ except ImportError:  # pragma: no cover - depends on optional native dep
 
 class EthDB:
     def __init__(self, path: str):
-        if not _PLYVEL:
-            raise ImportError(
-                "LevelDB support requires the optional 'plyvel' package "
-                "(C++ LevelDB binding), which is not installed."
+        if _PLYVEL:
+            self.db = plyvel.DB(path, create_if_missing=False)
+            self._overlay = None
+        else:
+            from mythril_tpu.ethereum.interface.leveldb.pyleveldb import (
+                PyLevelDB,
             )
-        self.db = plyvel.DB(path, create_if_missing=False)
+
+            self.db = PyLevelDB(path)
+            # the on-disk fallback is read-only; writes (the account
+            # index the hash->address path builds) land in a process-
+            # local overlay. plyvel persists the index, the fallback
+            # re-derives it per run — same answers, no durability.
+            self._overlay = {}
 
     def get(self, key: bytes):
+        if self._overlay is not None and key in self._overlay:
+            return self._overlay[key]
         return self.db.get(key)
 
     def put(self, key: bytes, value: bytes) -> None:
-        self.db.put(key, value)
+        if self._overlay is not None:
+            self._overlay[key] = value
+        else:
+            self.db.put(key, value)
 
     def write_batch(self):
+        if self._overlay is not None:
+            return _MemoryBatch(self._overlay)
         return self.db.write_batch()
 
     def __iter__(self):
@@ -53,20 +70,20 @@ class MemoryDB:
         self.data[key] = value
 
     def write_batch(self):
-        return _MemoryBatch(self)
+        return _MemoryBatch(self.data)
 
     def __iter__(self):
         return iter(self.data.items())
 
 
 class _MemoryBatch:
-    def __init__(self, db: MemoryDB):
-        self.db = db
+    def __init__(self, target: dict):
+        self.target = target
         self.pending = {}
 
     def put(self, key: bytes, value: bytes) -> None:
         self.pending[key] = value
 
     def write(self) -> None:
-        self.db.data.update(self.pending)
+        self.target.update(self.pending)
         self.pending = {}

@@ -1,0 +1,292 @@
+"""Pure-Python LevelDB: the on-disk format, no native dependency.
+
+The reference reads geth chaindata through the C++ LevelDB binding
+(`plyvel`), which this image cannot install. This module implements the
+LevelDB on-disk format directly so the chaindata layer works anywhere:
+
+- write-ahead **log format** (``NNNNNN.log``): 32KiB blocks of
+  [masked crc32c | length | type] records carrying WriteBatch payloads
+  (sequence, count, tagged put/delete entries with varint lengths);
+- **MANIFEST/CURRENT** enough to identify the live log files;
+- a read-only ``PyLevelDB`` that recovers the memtable by replaying the
+  logs in file order, and a ``PyLevelDBWriter`` producing a directory
+  any LevelDB reader (plyvel, geth) accepts — a freshly written,
+  never-compacted database keeps ALL data in its log, which is exactly
+  the shape the writer emits.
+
+Limitations (documented, not hidden): compacted databases move data
+into ``.ldb``/``.sst`` table files, which this reader does not parse —
+opening one raises with a clear message naming plyvel as the way to
+read compacted chaindata.
+
+Format reference: the public LevelDB documentation of log_format.h /
+write_batch.cc / filename.cc semantics (re-implemented, not copied).
+"""
+
+import os
+import re
+import struct
+from typing import Dict, Iterator, Optional, Tuple
+
+BLOCK_SIZE = 32768
+HEADER_SIZE = 7  # u32 crc | u16 length | u8 type
+FULL, FIRST, MIDDLE, LAST = 1, 2, 3, 4
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def _crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for n in range(256):
+        crc = n
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for byte in data:
+        crc = _TABLE[(crc ^ byte) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+
+
+# ---------------------------------------------------------------------------
+# log file: records
+
+
+def iter_log_records(raw: bytes) -> Iterator[bytes]:
+    """Reassemble the logical records of one log file."""
+    pos = 0
+    fragments = []
+    while pos + HEADER_SIZE <= len(raw):
+        block_left = BLOCK_SIZE - (pos % BLOCK_SIZE)
+        if block_left < HEADER_SIZE:
+            pos += block_left  # trailer padding
+            continue
+        crc, length, rtype = struct.unpack_from("<IHB", raw, pos)
+        if crc == 0 and length == 0 and rtype == 0:
+            break  # preallocated zero tail
+        payload = raw[pos + HEADER_SIZE : pos + HEADER_SIZE + length]
+        if len(payload) < length:
+            break  # truncated tail (crash mid-write): stop like leveldb
+        if masked_crc(bytes([rtype]) + payload) != crc:
+            raise ValueError("leveldb log record crc mismatch")
+        pos += HEADER_SIZE + length
+        if rtype == FULL:
+            yield payload
+        elif rtype == FIRST:
+            fragments = [payload]
+        elif rtype == MIDDLE:
+            fragments.append(payload)
+        elif rtype == LAST:
+            fragments.append(payload)
+            yield b"".join(fragments)
+            fragments = []
+        else:
+            raise ValueError(f"unknown leveldb record type {rtype}")
+
+
+def append_log_record(out: bytearray, payload: bytes) -> None:
+    """Append one logical record, fragmenting across 32KiB blocks."""
+    first = True
+    while True:
+        block_left = BLOCK_SIZE - (len(out) % BLOCK_SIZE)
+        if block_left < HEADER_SIZE:
+            out.extend(b"\x00" * block_left)
+            continue
+        avail = block_left - HEADER_SIZE
+        frag, payload = payload[:avail], payload[avail:]
+        end = not payload
+        rtype = (
+            FULL if first and end
+            else FIRST if first
+            else LAST if end
+            else MIDDLE
+        )
+        out.extend(struct.pack(
+            "<IHB", masked_crc(bytes([rtype]) + frag), len(frag), rtype
+        ))
+        out.extend(frag)
+        if end:
+            return
+        first = False
+
+
+# ---------------------------------------------------------------------------
+# write batches
+
+_TAG_DELETE, _TAG_PUT = 0, 1
+
+
+def decode_batch(payload: bytes) -> Tuple[int, list]:
+    """(sequence, [(key, value-or-None), ...]) of one WriteBatch."""
+    sequence = struct.unpack_from("<Q", payload, 0)[0]
+    count = struct.unpack_from("<I", payload, 8)[0]
+    pos = 12
+    ops = []
+    for _ in range(count):
+        tag = payload[pos]
+        pos += 1
+        klen, pos = _read_varint(payload, pos)
+        key = payload[pos : pos + klen]
+        pos += klen
+        if tag == _TAG_PUT:
+            vlen, pos = _read_varint(payload, pos)
+            value = payload[pos : pos + vlen]
+            pos += vlen
+            ops.append((key, value))
+        elif tag == _TAG_DELETE:
+            ops.append((key, None))
+        else:
+            raise ValueError(f"unknown write-batch tag {tag}")
+    return sequence, ops
+
+
+def encode_batch(sequence: int, ops) -> bytes:
+    out = bytearray(struct.pack("<QI", sequence, len(ops)))
+    for key, value in ops:
+        if value is None:
+            out.append(_TAG_DELETE)
+            out.extend(_varint(len(key)))
+            out.extend(key)
+        else:
+            out.append(_TAG_PUT)
+            out.extend(_varint(len(key)))
+            out.extend(key)
+            out.extend(_varint(len(value)))
+            out.extend(value)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# database
+
+_LOG_RE = re.compile(r"^(\d{6,})\.log$")
+
+
+class PyLevelDB:
+    """Read-only LevelDB opened by replaying its write-ahead logs."""
+
+    def __init__(self, path: str):
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no LevelDB directory at {path!r}")
+        if not os.path.exists(os.path.join(path, "CURRENT")):
+            raise ValueError(f"{path!r} is not a LevelDB (no CURRENT)")
+        tables = [
+            name
+            for name in os.listdir(path)
+            if name.endswith((".ldb", ".sst"))
+        ]
+        if tables:
+            raise NotImplementedError(
+                "this database has been compacted into table files "
+                f"({tables[0]} ...); the pure-Python reader only replays "
+                "write-ahead logs — install plyvel to read compacted "
+                "chaindata"
+            )
+        logs = sorted(
+            (
+                int(match.group(1)), name
+            )
+            for name in os.listdir(path)
+            if (match := _LOG_RE.match(name))
+        )
+        self._mem: Dict[bytes, Optional[bytes]] = {}
+        for _num, name in logs:
+            with open(os.path.join(path, name), "rb") as fh:
+                raw = fh.read()
+            for payload in iter_log_records(raw):
+                _seq, ops = decode_batch(payload)
+                for key, value in ops:
+                    self._mem[key] = value  # None = tombstone
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._mem.get(key)
+
+    def __iter__(self):
+        for key in sorted(self._mem):
+            value = self._mem[key]
+            if value is not None:
+                yield key, value
+
+
+class PyLevelDBWriter:
+    """Create a fresh (never-compacted) LevelDB directory.
+
+    Emits CURRENT, a minimal MANIFEST (comparator + log number +
+    next-file + last-sequence VersionEdit), and one log file carrying
+    every write — the exact state of a real LevelDB before its first
+    compaction, readable by any implementation.
+    """
+
+    # VersionEdit field tags (version_edit.cc)
+    _COMPARATOR, _LOG_NUMBER, _NEXT_FILE, _LAST_SEQ = 1, 2, 3, 4
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._log = bytearray()
+        self._sequence = 1
+
+    def put_many(self, items) -> None:
+        ops = [(key, value) for key, value in items]
+        append_log_record(self._log, encode_batch(self._sequence, ops))
+        self._sequence += len(ops)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.put_many([(key, value)])
+
+    def close(self) -> None:
+        edit = bytearray()
+        comparator = b"leveldb.BytewiseComparator"
+        edit.extend(_varint(self._COMPARATOR))
+        edit.extend(_varint(len(comparator)))
+        edit.extend(comparator)
+        edit.extend(_varint(self._LOG_NUMBER))
+        edit.extend(_varint(3))
+        edit.extend(_varint(self._NEXT_FILE))
+        edit.extend(_varint(4))
+        edit.extend(_varint(self._LAST_SEQ))
+        edit.extend(_varint(self._sequence))
+        manifest = bytearray()
+        append_log_record(manifest, bytes(edit))
+        with open(os.path.join(self.path, "MANIFEST-000002"), "wb") as fh:
+            fh.write(manifest)
+        with open(os.path.join(self.path, "CURRENT"), "w") as fh:
+            fh.write("MANIFEST-000002\n")
+        with open(os.path.join(self.path, "000003.log"), "wb") as fh:
+            fh.write(self._log)
+        with open(os.path.join(self.path, "LOCK"), "wb"):
+            pass

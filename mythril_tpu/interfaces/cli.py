@@ -307,7 +307,10 @@ def run_leveldb_search(args) -> None:
 
     config = _make_config(args)
     leveldb_dir = args.leveldb_dir or config.leveldb_dir
-    searcher = MythrilLevelDB(config.set_api_leveldb(leveldb_dir))
+    try:
+        searcher = MythrilLevelDB(config.set_api_leveldb(leveldb_dir))
+    except (OSError, ValueError, NotImplementedError, ImportError) as e:
+        raise CriticalError(f"Could not open LevelDB at {leveldb_dir!r}: {e}")
     searcher.search_db(args.search)
 
 
@@ -339,14 +342,18 @@ def run_truffle(args) -> None:
             log_msg = "Skipping unreadable artifact %s: %s" % (path, e)
             logging.getLogger(__name__).warning(log_msg)
             continue
-        deployed = (artifact.get("deployedBytecode") or "").strip()
-        creation = (artifact.get("bytecode") or "").strip()
-        if deployed in ("", "0x"):
+        def strip0x(value):
+            value = (value or "").strip()
+            return value[2:] if value.startswith("0x") else value
+
+        deployed = strip0x(artifact.get("deployedBytecode"))
+        creation = strip0x(artifact.get("bytecode"))
+        if not deployed:
             continue  # interfaces/abstract contracts have no runtime code
         contracts.append(
             EVMContract(
                 code=deployed,
-                creation_code=creation if creation not in ("", "0x") else "",
+                creation_code=creation,
                 name=artifact.get("contractName") or os.path.basename(path),
             )
         )
@@ -463,6 +470,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--version", action="version", version="v" + __version__)
     parser.add_argument("-v", metavar="LOG_LEVEL", type=int, default=2, dest="verbosity",
                         help="log level 0 (silent) .. 5 (trace)")
+    parser.add_argument("--epic", action="store_true",
+                        help=argparse.SUPPRESS)  # rainbow output (easter egg)
     subparsers = parser.add_subparsers(dest="command")
     for name, (help_text, flag_builders, _runner) in COMMANDS.items():
         aliases = [a for a, target in ALIASES.items() if target == name]
@@ -503,6 +512,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         parser.print_help()
         sys.exit(2)
     _set_verbosity(args.verbosity)
+    if args.epic:
+        from mythril_tpu.interfaces import epic
+
+        # TTY-gated: piped/redirected output (-o json in CI) stays clean
+        epic.engage()
     outform = getattr(args, "outform", "text")
     exit_code = 0
     try:

@@ -1,0 +1,75 @@
+"""`--epic` output mode (parity surface: mythril/interfaces/epic.py —
+the reference pipes its own output through a bundled lolcat clone).
+
+Implemented as a stdout filter instead of a re-exec pipeline: a
+text-stream wrapper that paints every printable character with a
+rainbow that advances along lines and wraps hue over time. Pure ANSI
+256-color, no dependencies, degrades to plain text when stdout is not
+a TTY (unless forced)."""
+
+import math
+import sys
+
+
+def _rainbow_color(position: float) -> int:
+    """ANSI 256-color cube index for a hue position in [0, 1)."""
+    angle = position * 2 * math.pi
+    red = int(3 * (1 + math.sin(angle)))
+    green = int(3 * (1 + math.sin(angle + 2 * math.pi / 3)))
+    blue = int(3 * (1 + math.sin(angle + 4 * math.pi / 3)))
+    return 16 + 36 * min(red, 5) + 6 * min(green, 5) + min(blue, 5)
+
+
+class EpicStream:
+    """File-like wrapper painting written text in a rolling rainbow."""
+
+    def __init__(self, stream, spread: float = 24.0):
+        self._stream = stream
+        self._spread = spread
+        self._row = 0
+        self._col = 0
+
+    def write(self, text: str) -> int:
+        out = []
+        for char in text:
+            if char == "\n":
+                self._row += 1
+                self._col = 0
+                out.append(char)
+            elif char.isspace():
+                self._col += 1
+                out.append(char)
+            else:
+                hue = ((self._col + 2 * self._row) % self._spread) / self._spread
+                out.append(f"\x1b[38;5;{_rainbow_color(hue)}m{char}")
+                self._col += 1
+        out.append("\x1b[0m")
+        return self._stream.write("".join(out))
+
+    def flush(self) -> None:
+        self._stream.flush()
+
+    def isatty(self) -> bool:
+        return self._stream.isatty()
+
+    def __getattr__(self, name):
+        return getattr(self._stream, name)
+
+
+def engage(force: bool = False) -> None:
+    """Route sys.stdout through the rainbow for the rest of the run."""
+    if force or sys.stdout.isatty():
+        sys.stdout = EpicStream(sys.stdout)
+
+
+def main() -> int:
+    """Filter stdin -> rainbow stdout (the reference's pipe form)."""
+    out = EpicStream(sys.stdout)
+    for line in sys.stdin:
+        out.write(line)
+    out.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

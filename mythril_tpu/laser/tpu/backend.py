@@ -751,6 +751,11 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             want_stats=want_stats,
             deadline=budget_deadline,
         )
+        # device wall captured NOW: _run_device's quiescence fetches have
+        # synced the final slice, and the download/dict-building below is
+        # host transport cost that must not inflate the device section
+        # (advisor r3)
+        device_wall = time.time() - round_start
         # one download: everything below (step counters, coverage merge,
         # per-lane unpack/lift) reads the host view for free
         out = transfer.batch_to_host(out)
@@ -766,9 +771,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 if n
             }
             if counts:
-                laser.iprof.record_device_round(
-                    counts, time.time() - round_start
-                )
+                laser.iprof.record_device_round(counts, device_wall)
         strategy.device_rounds += 1
         strategy.device_steps_retired += int(np.asarray(out.steps).sum())
 

@@ -77,7 +77,9 @@ def unused_imports(tree: ast.AST, source: str, is_init: bool):
 
 def main() -> int:
     problems = []
+    n_files = 0
     for path in iter_files():
+        n_files += 1
         rel = path.relative_to(REPO)
         source = path.read_text()
         try:
@@ -100,10 +102,7 @@ def main() -> int:
             problems.append(f"{rel}: no newline at end of file")
     for problem in problems:
         print(problem)
-    print(
-        f"lint: {len(problems)} problem(s) in "
-        f"{sum(1 for _ in iter_files())} files"
-    )
+    print(f"lint: {len(problems)} problem(s) in {n_files} files")
     return 1 if problems else 0
 
 

@@ -46,10 +46,10 @@ def _header_rlp(parent: bytes, state_root: bytes, number: int) -> bytes:
     return rlp.encode(fields)
 
 
-@pytest.fixture()
-def chaindata():
-    db = MemoryDB()
-
+def populate_chaindata(db) -> None:
+    """Author the canned chain into any ``.put(key, value)`` target —
+    the MemoryDB fixture here, and the on-disk LevelDB writer in
+    test_leveldb_disk.py (same bytes, real file format)."""
     # contract storage: slot 3 = 0x2a
     storage_root, storage_nodes = build_trie(
         {keccak256((3).to_bytes(32, "big")): rlp.encode(0x2A)}
@@ -92,6 +92,11 @@ def chaindata():
     # empty body for the header-by-number/body path
     db.put(lvl.body_prefix + (1).to_bytes(8, "big") + head_hash, rlp.encode([[], []]))
 
+
+@pytest.fixture()
+def chaindata():
+    db = MemoryDB()
+    populate_chaindata(db)
     return lvl.EthLevelDB(db=db)
 
 

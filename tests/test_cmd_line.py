@@ -103,9 +103,23 @@ def test_leveldb_search_missing_db_errors_cleanly():
         "leveldb-search", "deadbeef", "--leveldb-dir", "/nonexistent/chaindata"
     )
     assert proc.returncode == 1
-    assert "plyvel" in proc.stdout + proc.stderr or "LevelDB" in (
-        proc.stdout + proc.stderr
+    assert "Could not open LevelDB" in proc.stdout + proc.stderr
+
+
+def test_leveldb_search_on_disk_db(tmp_path):
+    """End-to-end: author a real-format LevelDB with code-bearing state
+    and search it from the CLI through the pure-Python reader."""
+    from mythril_tpu.ethereum.interface.leveldb.pyleveldb import PyLevelDBWriter
+    from tests.support.test_leveldb import populate_chaindata, CONTRACT_ADDR
+
+    writer = PyLevelDBWriter(str(tmp_path / "chaindata"))
+    populate_chaindata(writer)
+    writer.close()
+    proc = myth(
+        "leveldb-search", "60016001", "--leveldb-dir",
+        str(tmp_path / "chaindata"),
     )
+    assert "0x" + CONTRACT_ADDR.hex() in proc.stdout
 
 
 def test_truffle_analyzes_build_artifacts(tmp_path):
