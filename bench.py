@@ -25,6 +25,14 @@ import time
 
 import numpy as np
 
+_T0 = time.time()
+
+
+def _phase(msg: str) -> None:
+    """Progress marker on stderr: a wedged phase is identifiable from
+    partial output (the r3 bench timed out with no clue where)."""
+    print(f"bench[{time.time() - _T0:7.1f}s]: {msg}", file=sys.stderr, flush=True)
+
 
 def _probe_backend(timeout_s: int = 120) -> None:
     """Probe TPU backend health in a subprocess; fall back to CPU if wedged.
@@ -183,7 +191,9 @@ def _integrated_pipeline(
     )
     # compile the device kernels before the clock starts: the measured
     # number is the pipeline's throughput, not XLA's compile latency
+    _phase("  warmup_device(DEFAULT_BATCH_CFG)")
     backend.warmup_device(backend.DEFAULT_BATCH_CFG)
+    _phase("  warm; analyzing")
     t0 = time.time()
     sym = SymExecWrapper(
         contract,
@@ -203,6 +213,7 @@ def _integrated_pipeline(
 
 
 def main() -> int:
+    _phase("probing backend")
     _probe_backend()
 
     from mythril_tpu.disassembler.asm import assemble
@@ -215,14 +226,17 @@ def main() -> int:
     )
     creation_hex = assemble(creation_src).hex() + runtime.hex()
 
+    _phase("host baseline (stress contract)")
     host_rate = _host_states_per_sec(creation_hex)
 
     import jax
 
     platform = jax.devices()[0].platform
     lanes = 8192 if platform not in ("cpu",) else 1024
+    _phase(f"raw device kernel, {lanes} lanes on {platform}")
     device_rate = _device_states_per_sec(runtime, lanes)
 
+    _phase("integrated tpu-batch pipeline (stress contract)")
     integrated_rate, integrated_swcs = _integrated_pipeline(
         creation_hex, runtime.hex()
     )
@@ -244,10 +258,13 @@ def main() -> int:
         ).hex()
         + bec_runtime.hex()
     )
+    _phase("host baseline (BECToken)")
     bec_host_rate = _host_states_per_sec(bec_creation)
+    _phase("integrated tpu-batch pipeline (BECToken)")
     bec_rate, bec_swcs = _integrated_pipeline(
         bec_creation, bec_runtime.hex(), name="BECToken"
     )
+    _phase("done")
 
     print(
         json.dumps(

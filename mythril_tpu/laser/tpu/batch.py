@@ -71,6 +71,11 @@ class CodeBank(NamedTuple):
     code: jnp.ndarray  # u8[n_codes, code_len]
     code_len: jnp.ndarray  # i32[n_codes]
     jumpdest: jnp.ndarray  # bool[n_codes, code_len] valid JUMPDEST targets
+    # PUSH immediates pre-decoded per byte-pc (zero elsewhere): turns the
+    # step kernel's per-lane 32-byte code gather + big-endian assembly
+    # into one [L, 16] row gather — PUSH is the most common opcode, and
+    # byte-granularity gathers were the hottest ops in the step profile
+    push_imm: jnp.ndarray  # u32[n_codes, code_len, 16]
     host_ops: jnp.ndarray  # bool[256] opcodes that must return to the host
     freeze_errors: jnp.ndarray  # bool[] scalar
     # record storage events (and freeze-trap on ring overflow, and
@@ -138,7 +143,7 @@ class StateBatch(NamedTuple):
     tape_op: jnp.ndarray  # i32[L, T]
     tape_a: jnp.ndarray  # i32[L, T]
     tape_b: jnp.ndarray  # i32[L, T]
-    tape_imm: jnp.ndarray  # u32[L, T, 16]
+    tape_imm: jnp.ndarray  # u32[L, T*16] FLAT; row t = cols [16t, 16t+16) (see batch_shapes)
     tape_h1: jnp.ndarray  # u32[L, T] node identity hashes: the device
     tape_h2: jnp.ndarray  # u32[L, T] CSE scan compares only these planes
     tape_meta: jnp.ndarray  # u32[L, T] allocation-site pc|path_len (symtape.pack_meta)
@@ -213,7 +218,12 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "tape_op": ((L, T), np.int32),
         "tape_a": ((L, T), np.int32),
         "tape_b": ((L, T), np.int32),
-        "tape_imm": ((L, T, D), np.uint32),
+        # FLAT [L, T*D] (not [L, T, D]): 2D planes keep one canonical
+        # tiled layout on TPU — the 3D form made XLA satisfy the fork
+        # gather with a transposed layout and pay two full-plane
+        # transpose copies per step (symtape._alloc_impl reshapes a 3D
+        # view over the same bytes; row t = columns [t*D, (t+1)*D))
+        "tape_imm": ((L, T * D), np.uint32),
         "tape_h1": ((L, T), np.uint32),
         "tape_h2": ((L, T), np.uint32),
         "tape_meta": ((L, T), np.uint32),
@@ -265,19 +275,26 @@ def make_code_bank(
     code = np.zeros((n, code_len), dtype=np.uint8)
     lens = np.zeros((n,), dtype=np.int32)
     jd = np.zeros((n, code_len), dtype=bool)
+    pimm = np.zeros((n, code_len, words.NDIGITS), dtype=np.uint32)
     for i, c in enumerate(codes):
         if len(c) > code_len:
             raise ValueError(f"code {i} length {len(c)} exceeds bank width {code_len}")
         code[i, : len(c)] = np.frombuffer(bytes(c), dtype=np.uint8)
         lens[i] = len(c)
-        # Mark JUMPDESTs that are real instruction starts (not push data).
+        # Mark JUMPDESTs that are real instruction starts (not push data)
+        # and pre-decode PUSH immediates (truncated pushes zero-pad on the
+        # right, matching the EVM's implicit zero bytes past code end).
         pc = 0
         while pc < len(c):
             op = c[pc]
             if op == 0x5B:
                 jd[i, pc] = True
             if 0x60 <= op <= 0x7F:
-                pc += op - 0x5F
+                k = op - 0x5F
+                imm = bytes(c[pc + 1 : pc + 1 + k])
+                imm = imm + b"\x00" * (k - len(imm))
+                pimm[i, pc] = words.from_int(int.from_bytes(imm, "big"))
+                pc += k
             pc += 1
     hops = np.zeros(256, dtype=bool)
     for b in host_ops or ():
@@ -286,9 +303,10 @@ def make_code_bank(
         jnp.asarray(code),
         jnp.asarray(lens),
         jnp.asarray(jd),
-        jnp.asarray(hops),
-        jnp.asarray(bool(freeze_errors)),
-        jnp.asarray(bool(record_storage_events)),
+        push_imm=jnp.asarray(pimm),
+        host_ops=jnp.asarray(hops),
+        freeze_errors=jnp.asarray(bool(freeze_errors)),
+        record_storage_events=jnp.asarray(bool(record_storage_events)),
     )
 
 
@@ -305,12 +323,13 @@ def append_node(np_batch: dict, lane: int, op: int, a: int = 0, b: int = 0, imm=
     T = np_batch["tape_op"].shape[1]
     n = int(np_batch["tape_len"][lane])
     imm_row = np.zeros(words.NDIGITS, np.uint32) if imm is None else np.asarray(imm, np.uint32)
+    imm3 = np_batch["tape_imm"][lane].reshape(T, words.NDIGITS)
     for j in range(n):
         if (
             np_batch["tape_op"][lane, j] == op
             and np_batch["tape_a"][lane, j] == a
             and np_batch["tape_b"][lane, j] == b
-            and (np_batch["tape_imm"][lane, j] == imm_row).all()
+            and (imm3[j] == imm_row).all()
         ):
             return j + 1
     if n >= T:
@@ -318,7 +337,7 @@ def append_node(np_batch: dict, lane: int, op: int, a: int = 0, b: int = 0, imm=
     np_batch["tape_op"][lane, n] = op
     np_batch["tape_a"][lane, n] = a
     np_batch["tape_b"][lane, n] = b
-    np_batch["tape_imm"][lane, n] = imm_row
+    imm3[n] = imm_row  # view write-through into the flat plane
     h1, h2 = symtape.node_hash(op, a, b, imm_row, xp=np)
     np_batch["tape_h1"][lane, n] = h1
     np_batch["tape_h2"][lane, n] = h2
@@ -483,7 +502,7 @@ def read_tape(st: StateBatch, lane: int):
     ops = np.asarray(st.tape_op)[lane, :n]
     aa = np.asarray(st.tape_a)[lane, :n]
     bb = np.asarray(st.tape_b)[lane, :n]
-    imms = np.asarray(st.tape_imm)[lane, :n]
+    imms = np.asarray(st.tape_imm)[lane].reshape(-1, words.NDIGITS)[:n]
     return [
         (int(o), int(a), int(b), words.to_int(im))
         for o, a, b, im in zip(ops, aa, bb, imms)

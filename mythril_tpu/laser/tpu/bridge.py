@@ -567,7 +567,7 @@ class DeviceBridge:
         ops = np.asarray(st.tape_op)[lane]
         aa = np.asarray(st.tape_a)[lane]
         bb = np.asarray(st.tape_b)[lane]
-        imms = np.asarray(st.tape_imm)[lane]
+        imms = np.asarray(st.tape_imm)[lane].reshape(-1, words.NDIGITS)
         metas = np.asarray(st.tape_meta)[lane]
         path_ids = np.asarray(st.path_id)[lane]
         path_signs = np.asarray(st.path_sign)[lane]
@@ -927,7 +927,7 @@ class DeviceBridge:
         account = gs.environment.active_account
         tape_ops = np.asarray(st.tape_op)[lane]
         tape_a = np.asarray(st.tape_a)[lane]
-        tape_imm = np.asarray(st.tape_imm)[lane]
+        tape_imm = np.asarray(st.tape_imm)[lane].reshape(-1, words.NDIGITS)
         for key_int, val_int, ktag, vtag in read_storage_full(st, lane):
             if vtag > 0 and int(tape_ops[vtag - 1]) == symtape.OP_SLOAD:
                 leaf_a = int(tape_a[vtag - 1])

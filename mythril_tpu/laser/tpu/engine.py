@@ -400,21 +400,37 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
 
     # ------------------------------------------------------------------
-    # CALLDATALOAD / MLOAD (32-byte gathers)
+    # CALLDATALOAD / MLOAD: ONE shared 32-byte gather. Per-lane byte
+    # gathers are the costliest primitive in the step profile, and at
+    # most one of the two ops executes per lane per step — so both read
+    # through a single gather over memory++calldata with a per-lane base
+    # offset. (A vmapped dynamic_slice would be one window per lane, but
+    # XLA:TPU lowers batched-start slices to a SERIAL per-lane while
+    # loop — measured 100x worse than the gather.)
     g32 = jnp.arange(32, dtype=I32)
-    cd_idx = a32[:, None] + g32[None, :]
-    cd_bytes = jnp.where(
-        (cd_idx < st.calldata_len[:, None]) & a_fits[:, None],
-        st.calldata[lane[:, None], jnp.clip(cd_idx, 0, C - 1)],
-        0,
-    )
-    res = _sel(res, is_cdload, words.from_bytes_be(cd_bytes))
 
-    ml_idx = a32[:, None] + g32[None, :]
-    ml_bytes = jnp.where(
-        ml_idx < M, st.memory[lane[:, None], jnp.clip(ml_idx, 0, M - 1)], 0
+    def do_ld(_):
+        ld_src = jnp.concatenate([st.memory, st.calldata], axis=1)  # [L, M+C]
+        ld_off = jnp.where(is_cdload, a32 + M, a32)
+        ld_idx = ld_off[:, None] + g32[None, :]
+        cd_valid = (
+            a32[:, None] + g32[None, :] < st.calldata_len[:, None]
+        ) & a_fits[:, None]
+        ml_valid = a32[:, None] + g32[None, :] < M
+        ld_valid = jnp.where(is_cdload[:, None], cd_valid, ml_valid)
+        ld_bytes = jnp.where(
+            ld_valid, ld_src[lane[:, None], jnp.clip(ld_idx, 0, M + C - 1)], 0
+        )
+        return words.from_bytes_be(ld_bytes)
+
+    ld_word = jax.lax.cond(
+        jnp.any((is_mload | is_cdload) & running),
+        do_ld,
+        lambda _: jnp.zeros((L, words.NDIGITS), U32),
+        None,
     )
-    res = _sel(res, is_mload, words.from_bytes_be(ml_bytes))
+    res = _sel(res, is_cdload, ld_word)
+    res = _sel(res, is_mload, ld_word)
 
     # CALLDATALOAD on symbolic calldata -> a CDLOAD leaf (offset rides
     # inline when concrete, as a ref when itself symbolic)
@@ -488,18 +504,12 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
 
     # ------------------------------------------------------------------
-    # PUSH1..PUSH32 immediates (+ PUSH0)
+    # PUSH1..PUSH32 immediates (+ PUSH0): pre-decoded per byte-pc in the
+    # code bank, so a push is one [L, 16] row gather instead of a 32-byte
+    # code gather + big-endian assembly per lane
     is_push = (op >= 0x60) & (op <= 0x7F)
     k_push = jnp.where(is_push, op - 0x5F, 0)
-    pj = jnp.arange(32, dtype=I32)
-    src_imm = st.pc[:, None] + 1 + pj[None, :] - (32 - k_push[:, None])
-    pvalid = (pj[None, :] >= 32 - k_push[:, None]) & (src_imm < my_code_len[:, None]) & (
-        src_imm >= 0
-    )
-    pbytes = jnp.where(
-        pvalid, cb.code[st.code_id[:, None], jnp.clip(src_imm, 0, CL - 1)], 0
-    )
-    res = _sel(res, is_push, words.from_bytes_be(pbytes))
+    res = _sel(res, is_push, cb.push_imm[st.code_id, pc_safe])
     res = _sel(res, opmask(0x5F), words.zeros((L,)))  # PUSH0
 
     # ------------------------------------------------------------------
@@ -703,43 +713,54 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     sha_sym_mask = sha_sym_base & ~sha_bad
     nwords = b32 // 32
 
-    rest = jnp.zeros((L,), I32)
-    sha_ok = jnp.ones((L,), jnp.bool_)
-    for k in range(SHA_SYM_WORDS - 1, -1, -1):
-        woff = a32 + 32 * k
-        active = sha_sym_mask & (k < nwords)
-        we = ent_used & (ent_off == woff[:, None])
-        w_any = jnp.any(we, axis=-1)
-        w_slot = jnp.argmax(we, axis=-1)
-        w_id = st.msym_id[lane, w_slot]
-        widx = woff[:, None] + g32[None, :]
-        wbytes = jnp.where(
-            widx < M, st.memory[lane[:, None], jnp.clip(widx, 0, M - 1)], 0
-        )
-        wword = words.from_bytes_be(wbytes)
-        comb_a = jnp.where(w_any, w_id, symtape.ARG_IMM)
-        comb_imm = jnp.where(w_any[:, None], jnp.zeros_like(wword), wword)
-        tapes, comb_id, comb_ok = symtape.alloc(
+    # the whole COMB-chain build (including its per-word 32-byte memory
+    # gathers) only runs when some lane actually hashes symbolic memory —
+    # unconditional, the gathers alone dominated concrete-step wall time
+    def do_sha_sym(tapes):
+        rest = jnp.zeros((L,), I32)
+        sha_ok = jnp.ones((L,), jnp.bool_)
+        for k in range(SHA_SYM_WORDS - 1, -1, -1):
+            woff = a32 + 32 * k
+            active = sha_sym_mask & (k < nwords)
+            we = ent_used & (ent_off == woff[:, None])
+            w_any = jnp.any(we, axis=-1)
+            w_slot = jnp.argmax(we, axis=-1)
+            w_id = st.msym_id[lane, w_slot]
+            widx = woff[:, None] + g32[None, :]
+            wbytes = jnp.where(
+                widx < M, st.memory[lane[:, None], jnp.clip(widx, 0, M - 1)], 0
+            )
+            wword = words.from_bytes_be(wbytes)
+            comb_a = jnp.where(w_any, w_id, symtape.ARG_IMM)
+            comb_imm = jnp.where(w_any[:, None], jnp.zeros_like(wword), wword)
+            tapes, comb_id, comb_ok = symtape.alloc(
+                tapes,
+                active,
+                jnp.full((L,), symtape.OP_COMB, I32),
+                comb_a,
+                rest,
+                comb_imm,
+                alloc_meta,
+            )
+            rest = jnp.where(active, comb_id, rest)
+            sha_ok = sha_ok & comb_ok
+        tapes, sha_id, sha3_ok = symtape.alloc(
             tapes,
-            active,
-            jnp.full((L,), symtape.OP_COMB, I32),
-            comb_a,
+            sha_sym_mask,
+            jnp.full((L,), symtape.OP_SHA3, I32),
             rest,
-            comb_imm,
+            zero,
+            words.from_u32(b32.astype(U32)),
             alloc_meta,
         )
-        rest = jnp.where(active, comb_id, rest)
-        sha_ok = sha_ok & comb_ok
-    tapes, sha_id, sha3_ok = symtape.alloc(
-        tapes,
-        sha_sym_mask,
-        jnp.full((L,), symtape.OP_SHA3, I32),
-        rest,
-        zero,
-        words.from_u32(b32.astype(U32)),
-        alloc_meta,
+        return tapes, sha_id, sha_ok & sha3_ok
+
+    def skip_sha_sym(tapes):
+        return tapes, jnp.zeros((L,), I32), jnp.ones((L,), jnp.bool_)
+
+    tapes, sha_id, sha_ok = jax.lax.cond(
+        jnp.any(sha_sym_mask), do_sha_sym, skip_sha_sym, tapes
     )
-    sha_ok = sha_ok & sha3_ok
 
     # ------------------------------------------------------------------
     # DUP / SWAP
@@ -949,14 +970,19 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # this step", which makes them free in the common case.
     midx = jnp.arange(M, dtype=I32)[None, :]  # [1, M]
     mem = st.memory
-    # MSTORE (symbolic values zero the byte range; the overlay holds them)
+    # MSTORE (symbolic values zero the byte range; the overlay holds them);
+    # gated on "any lane stores this step" like the load gather
     wmask = committed & is_mstore
-    b_bytes = jnp.where(
-        has_b[:, None], 0, words.to_bytes_be(b)
-    ).astype(jnp.uint8)  # [L, 32]
-    ms_pos = m_off[:, None] + g32[None, :]
-    ms_idx = jnp.where(wmask[:, None] & (ms_pos < M), ms_pos, M)
-    mem = mem.at[lane[:, None], ms_idx].set(b_bytes, mode="drop")
+
+    def do_mstore(mem):
+        b_bytes = jnp.where(
+            has_b[:, None], 0, words.to_bytes_be(b)
+        ).astype(jnp.uint8)  # [L, 32]
+        ms_pos = m_off[:, None] + g32[None, :]
+        ms_idx = jnp.where(wmask[:, None] & (ms_pos < M), ms_pos, M)
+        return mem.at[lane[:, None], ms_idx].set(b_bytes, mode="drop")
+
+    mem = jax.lax.cond(jnp.any(wmask), do_mstore, lambda m: m, mem)
     # MSTORE8
     w8 = committed & is_mstore8
     low_byte = (b[:, 0] & 0xFF).astype(jnp.uint8)

@@ -219,8 +219,16 @@ def _alloc_impl(tapes, mask, op, a, b, imm, meta):
         tape_meta, tape_len,
     ) = tapes
     L, T = tape_op.shape
+    D = imm.shape[-1]
     lane = jnp.arange(L)
     slot = jnp.arange(T)[None, :]
+
+    # tape_imm is carried FLAT ([L, T*D]) in the state batch — 2D planes
+    # keep one canonical tiled layout, where the 3D form made XLA pick a
+    # transposed layout for the fork gather and insert two full-plane
+    # transpose copies into every step. The 3D view below is a reshape
+    # (bitcast) of the same bytes.
+    ti3 = tape_imm.reshape(L, T, D)
 
     h1, h2 = node_hash(op, a, b, imm)
 
@@ -233,7 +241,7 @@ def _alloc_impl(tapes, mask, op, a, b, imm, meta):
         & (tape_op[lane, cand] == op)
         & (tape_a[lane, cand] == a)
         & (tape_b[lane, cand] == b)
-        & jnp.all(tape_imm[lane, cand] == imm, axis=-1)
+        & jnp.all(ti3[lane, cand] == imm, axis=-1)
     )
 
     overflow = tape_len >= T
@@ -251,9 +259,10 @@ def _alloc_impl(tapes, mask, op, a, b, imm, meta):
     tape_h1 = put(tape_h1, h1)
     tape_h2 = put(tape_h2, h2)
     tape_meta = put(tape_meta, meta)
-    tape_imm = tape_imm.at[lane, widx].set(
-        jnp.where(do_new[:, None], imm, tape_imm[lane, widx])
+    ti3 = ti3.at[lane, widx].set(
+        jnp.where(do_new[:, None], imm, ti3[lane, widx])
     )
+    tape_imm = ti3.reshape(L, T * D)
     new_len = tape_len + do_new.astype(jnp.int32)
 
     id1 = jnp.where(mask, jnp.where(hit, cand, tape_len) + 1, 0)

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mythril_tpu.laser.tpu import words
+
 from mythril_tpu.laser.tpu.batch import StateBatch, batch_shapes
 
 # planes the host-side consumers (bridge lift/unpack, coverage merge,
@@ -41,6 +43,12 @@ _TAPE_PLANES = (
     "tape_meta",
 )
 _TAPE_BUCKETS = (16, 64, 256, 1024, 4096)
+
+# tape_imm is carried FLAT ([L, T*NDIGITS]) so the step kernel keeps one
+# canonical 2D layout (symtape._alloc_impl); its per-row column count
+# scales accordingly when slicing/padding the used-row prefix
+def _tape_cols(name: str, rows: int) -> int:
+    return rows * words.NDIGITS if name == "tape_imm" else rows
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -144,7 +152,7 @@ def batch_to_device(np_batch: dict, cfg) -> StateBatch:
             continue
         arr = np_batch[name]
         if name in _TAPE_PLANES:
-            arr = arr[:, :t_used]
+            arr = arr[:, : _tape_cols(name, t_used)]
         segments.append(arr)
     full_key = tuple(
         (name, tuple(shape), np.dtype(dtype).str)
@@ -164,7 +172,7 @@ def _split_batch(buf, full_key, absent, t_used):
             continue
         shape = full_shape
         if name in _TAPE_PLANES:
-            shape = (shape[0], t_used) + tuple(shape[2:])
+            shape = (shape[0], _tape_cols(name, t_used)) + tuple(shape[2:])
         spec.append((shape, dtype_str))
         shipped.append(name)
     parts = dict(zip(shipped, split_segments(buf, tuple(spec))))
@@ -240,7 +248,7 @@ def batch_to_host(st: StateBatch) -> StateBatch:
         dev = getattr(st, f)
         shape = tuple(dev.shape)
         if f in _TAPE_PLANES:
-            shape = (shape[0], t_used) + shape[2:]
+            shape = (shape[0], _tape_cols(f, t_used)) + shape[2:]
         big_shapes.append((f, shape, np.dtype(dev.dtype)))
     planes.update(
         _unpack_host(
@@ -250,9 +258,9 @@ def batch_to_host(st: StateBatch) -> StateBatch:
     # pad sliced tape planes back to capacity (rows at or past tape_len
     # are dead by invariant, so zeros are equivalent)
     for f in _TAPE_PLANES:
-        if f in planes and planes[f].shape[1] != cap:
+        if f in planes and planes[f].shape[1] != _tape_cols(f, cap):
             full = np.zeros(
-                (planes[f].shape[0], cap) + planes[f].shape[2:],
+                (planes[f].shape[0], _tape_cols(f, cap)) + planes[f].shape[2:],
                 planes[f].dtype,
             )
             full[:, : planes[f].shape[1]] = planes[f]
@@ -269,7 +277,7 @@ def _flatten_device(st: StateBatch, fields, t_used=None):
     for name in fields:
         x = getattr(st, name)
         if t_used is not None and name in _TAPE_PLANES:
-            x = x[:, :t_used]
+            x = x[:, : _tape_cols(name, t_used)]
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.uint8)
         if x.dtype.itemsize > 1:

@@ -1,0 +1,138 @@
+"""Attribute per-step device cost by opcode family: run contracts that
+exercise different subsets and compare per-iteration wall time."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu.batch import (
+    BatchConfig, build_batch, default_env, make_code_bank,
+)
+from mythril_tpu.laser.tpu.engine import run
+
+L = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+STEPS = 256
+
+ARITH = """
+start:
+    JUMPDEST
+    PUSH1 0x01
+    PUSH1 0x02
+    ADD
+    PUSH1 0x03
+    MUL
+    POP
+    PUSH2 :start
+    JUMP
+"""
+
+ARITH_NOJUMP = """
+    PUSH1 0x00
+    CALLDATALOAD
+loop:
+    JUMPDEST
+    PUSH1 0x01
+    ADD
+    DUP1
+    PUSH4 0xFFFFFFFF
+    LT
+    PUSH2 :loop
+    JUMPI
+    STOP
+"""
+
+SHA = """
+start:
+    JUMPDEST
+    PUSH1 0x20
+    PUSH1 0x00
+    SHA3
+    POP
+    PUSH2 :start
+    JUMP
+"""
+
+STORE = """
+start:
+    JUMPDEST
+    PUSH1 0x05
+    PUSH1 0x07
+    SSTORE
+    PUSH1 0x07
+    SLOAD
+    POP
+    PUSH2 :start
+    JUMP
+"""
+
+MEM = """
+start:
+    JUMPDEST
+    PUSH1 0x2A
+    PUSH1 0x40
+    MSTORE
+    PUSH1 0x40
+    MLOAD
+    POP
+    PUSH2 :start
+    JUMP
+"""
+
+DIV = """
+start:
+    JUMPDEST
+    PUSH1 0x07
+    PUSH4 0xDEADBEEF
+    DIV
+    POP
+    PUSH2 :start
+    JUMP
+"""
+
+EXP = """
+start:
+    JUMPDEST
+    PUSH1 0x07
+    PUSH1 0x03
+    EXP
+    POP
+    PUSH2 :start
+    JUMP
+"""
+
+cfg = BatchConfig(
+    lanes=L, stack_slots=32, memory_bytes=512, calldata_bytes=64,
+    storage_slots=8, code_len=512,
+)
+env = default_env()
+
+for name, src in [
+    ("arith", ARITH), ("sha3", SHA), ("sstore", STORE),
+    ("memory", MEM), ("div", DIV), ("exp", EXP),
+]:
+    code = assemble(src)
+    cb = make_code_bank([code], cfg.code_len)
+    specs = [
+        dict(calldata=(i + 1).to_bytes(32, "big"), caller=0x1000 + i)
+        for i in range(L)
+    ]
+    st = build_batch(cfg, specs)
+    out = run(cb, env, st, max_steps=STEPS)
+    out.status.block_until_ready()
+    st = build_batch(cfg, specs)
+    jax.block_until_ready(st)
+    t = time.time()
+    out = run(cb, env, st, max_steps=STEPS)
+    out.status.block_until_ready()
+    dt = time.time() - t
+    total = int(np.asarray(out.steps).sum())
+    print(
+        f"{name:8s}: {dt*1e3:8.1f} ms  {dt/STEPS*1e6:7.0f} us/iter  "
+        f"{total/dt/1e3:8.1f}k states/s",
+        flush=True,
+    )

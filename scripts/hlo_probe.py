@@ -1,0 +1,75 @@
+"""Dump compiled-HLO stats for the step kernel: fusion count, cost analysis."""
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu.batch import (
+    BatchConfig, build_batch, default_env, make_code_bank,
+)
+from mythril_tpu.laser.tpu import engine
+
+L = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+
+cfg = BatchConfig(
+    lanes=L, stack_slots=32, memory_bytes=512, calldata_bytes=64,
+    storage_slots=8, code_len=512,
+)
+code = assemble("JUMPDEST\nPUSH1 0x01\nPUSH1 0x02\nADD\nPOP\nPUSH1 0x00\nJUMP")
+cb = make_code_bank([code], cfg.code_len)
+env = default_env()
+st = build_batch(cfg, [dict(calldata=b"\x01", caller=1)])
+
+lowered = jax.jit(engine.step_impl).lower(cb, env, st)
+compiled = lowered.compile()
+txt = compiled.as_text()
+print(f"HLO text: {len(txt)} chars, {txt.count(chr(10))} lines", flush=True)
+
+ops = Counter()
+for line in txt.splitlines():
+    line = line.strip()
+    if "= fusion(" in line:
+        ops["fusion"] += 1
+    elif "= while(" in line:
+        ops["while"] += 1
+    elif "= conditional(" in line:
+        ops["conditional"] += 1
+    elif "= scatter(" in line or " scatter(" in line:
+        ops["scatter"] += 1
+    elif "= gather(" in line:
+        ops["gather"] += 1
+    elif "= copy(" in line:
+        ops["copy"] += 1
+    elif "custom-call" in line:
+        ops["custom-call"] += 1
+print("top-level op mix:", dict(ops), flush=True)
+
+ca = compiled.cost_analysis()
+if ca:
+    c = ca[0] if isinstance(ca, (list, tuple)) else ca
+    interesting = {
+        k: v
+        for k, v in sorted(c.items())
+        if k in ("flops", "bytes accessed", "transcendentals", "optimal_seconds")
+        or k.startswith("bytes accessed")
+    }
+    for k, v in list(interesting.items())[:12]:
+        print(f"  {k}: {v:,.0f}" if isinstance(v, float) else f"  {k}: {v}", flush=True)
+
+mem = compiled.memory_analysis()
+if mem:
+    print(
+        f"  temp {mem.temp_size_in_bytes/1e6:.1f} MB, "
+        f"args {mem.argument_size_in_bytes/1e6:.1f} MB, "
+        f"out {mem.output_size_in_bytes/1e6:.1f} MB",
+        flush=True,
+    )
+
+out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "step_hlo.txt")
+with open(out, "w") as f:
+    f.write(txt)
+print(f"wrote {out}", flush=True)

@@ -1,0 +1,33 @@
+"""Dump optimized HLO of _run_impl (same shapes as trace_probe)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu.batch import (
+    BatchConfig, build_batch, default_env, make_code_bank,
+)
+from mythril_tpu.laser.tpu import engine
+
+L = 1024
+cfg = BatchConfig(
+    lanes=L, stack_slots=32, memory_bytes=512, calldata_bytes=64,
+    storage_slots=8, code_len=512,
+)
+code = assemble(
+    "start:\nJUMPDEST\nPUSH1 0x01\nPUSH1 0x02\nADD\nPUSH1 0x03\nMUL\nPOP\nPUSH2 :start\nJUMP"
+)
+cb = make_code_bank([code], cfg.code_len)
+env = default_env()
+st = build_batch(cfg, [dict(calldata=b"\x01", caller=1)] * L)
+lowered = jax.jit(
+    engine._run_impl, static_argnames=("max_steps", "with_stats"),
+    donate_argnames=("st",),
+).lower(cb, env, st, max_steps=64, with_stats=False)
+txt = lowered.compile().as_text()
+with open("scripts/run_hlo.txt", "w") as f:
+    f.write(txt)
+print("lines:", txt.count("\n"), flush=True)

@@ -1,0 +1,127 @@
+"""Phase-timed probe of the device engine on whatever backend is live.
+
+Prints one line per phase so a wedged phase is identifiable from partial
+output. Usage: python3 scripts/tpu_probe.py [lanes] [max_steps]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.time()
+
+
+def mark(msg):
+    print(f"[{time.time() - t0:7.1f}s] {msg}", flush=True)
+
+
+lanes = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+max_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+
+mark("importing jax")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+mark(f"devices: {jax.devices()}")
+x = jnp.ones((256, 256), jnp.float32)
+y = (x @ x).block_until_ready()
+mark("matmul warm")
+t = time.time()
+for _ in range(10):
+    y = (x @ x).block_until_ready()
+mark(f"matmul dispatch latency {(time.time()-t)/10*1e3:.2f} ms")
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu.batch import (
+    BatchConfig, build_batch, default_env, make_code_bank,
+)
+from mythril_tpu.laser.tpu.engine import run
+from mythril_tpu.support.keccak import keccak256
+
+src = open("bench_contracts/token.asm").read() if False else None
+STRESS = """
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH1 0x20
+    CALLDATALOAD
+    DUP2
+    DUP2
+    MUL
+    CALLER
+    PUSH1 0x00
+    MSTORE
+    PUSH1 0x20
+    PUSH1 0x00
+    SHA3
+    SLOAD
+    LT
+    PUSH2 :revert
+    JUMPI
+loop:
+    JUMPDEST
+    DUP1
+    ISZERO
+    PUSH2 :done
+    JUMPI
+    PUSH1 0x20
+    PUSH1 0x00
+    SHA3
+    DUP2
+    SWAP1
+    SSTORE
+    PUSH1 0x01
+    SWAP1
+    SUB
+    PUSH2 :loop
+    JUMP
+done:
+    JUMPDEST
+    STOP
+revert:
+    JUMPDEST
+    PUSH1 0x00
+    PUSH1 0x00
+    REVERT
+"""
+code = assemble(STRESS)
+mark(f"assembled {len(code)} bytes; building cfg lanes={lanes}")
+
+cfg = BatchConfig(
+    lanes=lanes, stack_slots=32, memory_bytes=512, calldata_bytes=64,
+    storage_slots=8, code_len=512,
+)
+cb = make_code_bank([code], cfg.code_len)
+env = default_env()
+
+
+def fresh():
+    specs = []
+    for lane in range(lanes):
+        caller = 0x1000 + lane
+        cd = (lane + 1).to_bytes(32, "big") + (lane % 7 + 1).to_bytes(32, "big")
+        slot = int.from_bytes(keccak256(caller.to_bytes(32, "big")), "big")
+        specs.append(dict(calldata=cd, caller=caller, storage={slot: 10**12}))
+    return build_batch(cfg, specs)
+
+
+mark("building batch")
+st = fresh()
+jax.block_until_ready(st)
+mark("batch on device; compiling+running first run()")
+out = run(cb, env, st, max_steps=max_steps)
+out.status.block_until_ready()
+mark(f"first run done, steps={int(np.asarray(out.steps).sum())}")
+
+st = fresh()
+jax.block_until_ready(st)
+t = time.time()
+out = run(cb, env, st, max_steps=max_steps)
+out.status.block_until_ready()
+dt = time.time() - t
+total = int(np.asarray(out.steps).sum())
+mark(
+    f"timed run: {dt*1e3:.1f} ms, {total} states, "
+    f"{total/dt:.0f} states/s, {dt/max_steps*1e6:.0f} us/iter(upper)"
+)

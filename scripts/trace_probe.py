@@ -1,0 +1,44 @@
+"""Try jax.profiler tracing of one timed run; fall back gracefully."""
+import glob
+import gzip
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu.batch import (
+    BatchConfig, build_batch, default_env, make_code_bank,
+)
+from mythril_tpu.laser.tpu.engine import run
+
+L = 1024
+cfg = BatchConfig(
+    lanes=L, stack_slots=32, memory_bytes=512, calldata_bytes=64,
+    storage_slots=8, code_len=512,
+)
+code = assemble(
+    "start:\nJUMPDEST\nPUSH1 0x01\nPUSH1 0x02\nADD\nPUSH1 0x03\nMUL\nPOP\nPUSH2 :start\nJUMP"
+)
+cb = make_code_bank([code], cfg.code_len)
+env = default_env()
+specs = [dict(calldata=b"\x01", caller=0x1000 + i) for i in range(L)]
+st = build_batch(cfg, specs)
+out = run(cb, env, st, max_steps=64)
+out.status.block_until_ready()
+print("warm", flush=True)
+
+st = build_batch(cfg, specs)
+jax.block_until_ready(st)
+os.makedirs("/tmp/jaxtrace", exist_ok=True)
+with jax.profiler.trace("/tmp/jaxtrace"):
+    out = run(cb, env, st, max_steps=64)
+    out.status.block_until_ready()
+print("traced", flush=True)
+files = glob.glob("/tmp/jaxtrace/**/*", recursive=True)
+for f in files:
+    print(f, os.path.getsize(f) if os.path.isfile(f) else "dir", flush=True)
