@@ -213,6 +213,11 @@ def _integrated_pipeline(
 
 
 def main() -> int:
+    # persistent compile cache BEFORE jax initializes: the raw-kernel
+    # phase below is the first (and most expensive) compile of the run
+    from mythril_tpu.laser.tpu import ensure_compile_cache
+
+    ensure_compile_cache()
     _phase("probing backend")
     _probe_backend()
 

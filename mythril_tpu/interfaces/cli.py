@@ -492,19 +492,10 @@ def _set_verbosity(level: int) -> None:
 
 def main(argv: Optional[List[str]] = None) -> None:
     # persistent XLA compile cache: the device kernels take tens of
-    # seconds to compile; repeat CLI invocations should pay that once.
-    # The env var only reaches jax if it is imported later; when a
-    # sitecustomize already imported jax at interpreter start, the config
-    # must be updated directly.
-    cache_dir = os.path.join(
-        os.path.expanduser("~"), ".cache", "mythril_tpu", "jax"
-    )
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
-    if "jax" in sys.modules:
-        sys.modules["jax"].config.update(
-            "jax_compilation_cache_dir",
-            os.environ["JAX_COMPILATION_CACHE_DIR"],
-        )
+    # seconds to compile; repeat CLI invocations should pay that once
+    from mythril_tpu.laser.tpu import ensure_compile_cache
+
+    ensure_compile_cache()
     parser = build_parser()
     args = parser.parse_args(argv)
     command = ALIASES.get(args.command, args.command)

@@ -5,10 +5,47 @@ per-object interpreter loop (mythril/laser/ethereum/svm.py:220 exec / one
 GlobalState at a time) with a batched, jittable step over thousands of
 path-lanes packed SoA in HBM:
 
-- words.py    — 256-bit EVM word arithmetic as 16x16-bit digit limbs (u32 lanes)
-- state.py    — the SoA state batch (pytree) incl. on-device expression table
-- step.py     — the fused one-instruction step kernel + JUMPI lane forking
-- engine.py   — host driver bridging the batch world to the LaserEVM API
-- solver_jax.py — batched tape evaluation / local-search witness finding
-- sharding.py — pjit/shard_map multi-chip path parallelism
+- words.py      — 256-bit EVM word arithmetic as 16x16-bit digit limbs (u32 lanes)
+- batch.py      — the SoA state batch (pytree) + code bank
+- symtape.py    — per-lane symbolic term tapes (device expression DAG)
+- engine.py     — the fused one-instruction step kernel + JUMPI lane forking
+- backend.py    — host driver bridging the batch world to the LaserEVM API
+- bridge.py     — term-tape lift/pack between host SMT layer and device
+- solver_jax.py — batched CNF feasibility kernel
+- transfer.py   — single-buffer host<->device plane transport
+- mesh.py       — sharded multi-device lockstep rounds + rebalance
 """
+
+import os
+import sys
+
+
+def ensure_compile_cache() -> None:
+    """Point jax at a persistent on-disk compile cache.
+
+    The step/solve kernels take tens of seconds (CPU) to minutes
+    (tunneled TPU) to compile; every entry point that can initialize
+    jax for device work (CLI, bench, library warmup) funnels through
+    here so repeat invocations pay the compile once per machine.
+    Safe to call any number of times. Deliberately does NOT import jax:
+    the env vars cover a later import, and the config path covers a
+    sitecustomize that imported jax at interpreter start — so CLI
+    commands that never touch a device keep their fast startup.
+    """
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "mythril_tpu", "jax"
+    )
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    # default floor is 1s of compile time; these kernels always clear
+    # it, but pin a low floor so smaller helpers cache too
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    if "jax" in sys.modules:  # env vars alone are too late by then
+        try:
+            jax = sys.modules["jax"]
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+            )
+        except Exception:  # pragma: no cover - cache is best-effort
+            pass

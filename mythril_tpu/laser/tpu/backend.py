@@ -420,7 +420,10 @@ def warmup_device(cfg: BatchConfig, want_stats: bool = False) -> None:
 def _do_warmup(key, event) -> None:
     cfg, want_stats = key
     try:
+        from mythril_tpu.laser.tpu import ensure_compile_cache
         from mythril_tpu.laser.tpu.batch import batch_shapes, make_code_bank
+
+        ensure_compile_cache()
 
         np_batch = {
             field: np.zeros(shape, dtype)

@@ -152,10 +152,18 @@ def _pow2(n: int, lo: int = 16) -> int:
 
 
 def _pack_batch(instances: List[CNFInstance], pad_vars: int, pad_clauses: int):
-    """Pad live instances into canonical [I, C, 3] clause tensors."""
+    """Pad live instances into canonical [I, C, 3] clause tensors.
+
+    On accelerator backends the batch axis pads all the way to
+    MAX_BATCH: each power-of-two bucket is a separate multi-minute XLA
+    compile of the solve kernel over the tunnel, while the padded dead
+    instances cost microseconds of device work.
+    """
     C = pad_clauses
     V = pad_vars
-    I = _pow2(len(instances), lo=1)
+    from mythril_tpu.laser.tpu import transfer
+
+    I = _pow2(len(instances), lo=MAX_BATCH if transfer.monomorphic() else 1)
     lits = np.zeros((I, C, 3), dtype=np.int32)
     nvars = np.zeros((I,), dtype=np.int32)
     is_input = np.zeros((I, V), dtype=bool)

@@ -44,6 +44,28 @@ _TAPE_PLANES = (
 )
 _TAPE_BUCKETS = (16, 64, 256, 1024, 4096)
 
+
+_MONO: list = []  # [bool] memo
+
+
+def monomorphic() -> bool:
+    """One jit variant per transfer direction on accelerator backends.
+
+    Every (tape bucket, absent-group) combination is a separate XLA
+    compile of the splitter/flattener; on the tunneled TPU a compile
+    costs MINUTES while the bytes a smaller variant saves ride a link
+    whose per-transfer latency dwarfs them. CPU keeps the polymorphic
+    path: compiles are cheap there and the suite exercises it.
+    """
+    if not _MONO:
+        try:
+            import jax
+
+            _MONO.append(jax.devices()[0].platform != "cpu")
+        except Exception:
+            _MONO.append(False)
+    return _MONO[0]
+
 # tape_imm is carried FLAT ([L, T*NDIGITS]) so the step kernel keeps one
 # canonical 2D layout (symtape._alloc_impl); its per-row column count
 # scales accordingly when slicing/padding the used-row prefix
@@ -138,14 +160,18 @@ def batch_to_device(np_batch: dict, cfg) -> StateBatch:
     hundred KB instead of the full batch.
     """
     shapes = batch_shapes(cfg)
-    t_used = _bucket(int(np_batch["tape_len"].max()), cfg.tape_slots)
-    absent = tuple(
-        sorted(
-            group
-            for group, planes in _UP_GROUPS.items()
-            if not any(np_batch[p].any() for p in planes)
+    if monomorphic():
+        t_used = cfg.tape_slots
+        absent = ()
+    else:
+        t_used = _bucket(int(np_batch["tape_len"].max()), cfg.tape_slots)
+        absent = tuple(
+            sorted(
+                group
+                for group, planes in _UP_GROUPS.items()
+                if not any(np_batch[p].any() for p in planes)
+            )
         )
-    )
     segments = []
     for name in shapes:
         if _GROUP_OF.get(name) in absent:
@@ -242,7 +268,9 @@ def batch_to_host(st: StateBatch) -> StateBatch:
     planes = _unpack_host(np.asarray(_flatten_device(st, small)), small_shapes)
 
     cap = int(st.tape_op.shape[1])
-    t_used = _bucket(int(planes["tape_len"].max()), cap)
+    t_used = (
+        cap if monomorphic() else _bucket(int(planes["tape_len"].max()), cap)
+    )
     big_shapes = []
     for f in _BIG_DOWN:
         dev = getattr(st, f)

@@ -69,7 +69,7 @@ if mem:
         flush=True,
     )
 
-out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "step_hlo.txt")
+out = "/tmp/step_hlo.txt"
 with open(out, "w") as f:
     f.write(txt)
 print(f"wrote {out}", flush=True)

@@ -28,6 +28,6 @@ lowered = jax.jit(
     donate_argnames=("st",),
 ).lower(cb, env, st, max_steps=64, with_stats=False)
 txt = lowered.compile().as_text()
-with open("scripts/run_hlo.txt", "w") as f:
+with open("/tmp/run_hlo.txt", "w") as f:
     f.write(txt)
 print("lines:", txt.count("\n"), flush=True)

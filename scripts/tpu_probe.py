@@ -40,51 +40,8 @@ from mythril_tpu.laser.tpu.batch import (
 from mythril_tpu.laser.tpu.engine import run
 from mythril_tpu.support.keccak import keccak256
 
-src = open("bench_contracts/token.asm").read() if False else None
-STRESS = """
-    PUSH1 0x00
-    CALLDATALOAD
-    PUSH1 0x20
-    CALLDATALOAD
-    DUP2
-    DUP2
-    MUL
-    CALLER
-    PUSH1 0x00
-    MSTORE
-    PUSH1 0x20
-    PUSH1 0x00
-    SHA3
-    SLOAD
-    LT
-    PUSH2 :revert
-    JUMPI
-loop:
-    JUMPDEST
-    DUP1
-    ISZERO
-    PUSH2 :done
-    JUMPI
-    PUSH1 0x20
-    PUSH1 0x00
-    SHA3
-    DUP2
-    SWAP1
-    SSTORE
-    PUSH1 0x01
-    SWAP1
-    SUB
-    PUSH2 :loop
-    JUMP
-done:
-    JUMPDEST
-    STOP
-revert:
-    JUMPDEST
-    PUSH1 0x00
-    PUSH1 0x00
-    REVERT
-"""
+from bench import STRESS_SRC as STRESS  # same workload bench measures
+
 code = assemble(STRESS)
 mark(f"assembled {len(code)} bytes; building cfg lanes={lanes}")
 

@@ -1,14 +1,11 @@
 """Try jax.profiler tracing of one timed run; fall back gracefully."""
 import glob
-import gzip
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import numpy as np
 
 from mythril_tpu.disassembler.asm import assemble
 from mythril_tpu.laser.tpu.batch import (

@@ -80,3 +80,16 @@ def test_roundtrip_groups_skipped():
     cfg = small_cfg()
     for groups in (("symbolic",), ("memory", "storage"), tuple(transfer._UP_GROUPS)):
         roundtrip(cfg, random_batch(cfg, zero_groups=groups))
+
+
+def test_roundtrip_monomorphic():
+    # accelerator mode: one jit variant — no tape slicing, no group
+    # skipping — must round-trip the same bytes (here forced on CPU)
+    transfer._MONO.clear()
+    transfer._MONO.append(True)
+    try:
+        cfg = small_cfg()._replace(tape_slots=64)
+        roundtrip(cfg, random_batch(cfg, tape_len=5))
+        roundtrip(cfg, random_batch(cfg, zero_groups=("symbolic",)))
+    finally:
+        transfer._MONO.clear()
