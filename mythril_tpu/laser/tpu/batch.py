@@ -108,14 +108,14 @@ class StateBatch(NamedTuple):
     trap_op: jnp.ndarray  # i32[L] opcode that caused TRAP
     pc: jnp.ndarray  # i32[L]
     code_id: jnp.ndarray  # i32[L] row into CodeBank
-    stack: jnp.ndarray  # u32[L, S, 16]
+    stack: jnp.ndarray  # u32[L, S*16] FLAT (see batch_shapes)
     sp: jnp.ndarray  # i32[L] number of live stack slots
     memory: jnp.ndarray  # u8[L, M]
     mem_words: jnp.ndarray  # i32[L] EVM msize / 32 (expansion high-water)
     gas_left: jnp.ndarray  # u32[L] gas remaining under the MIN-cost model
     gas_spent_max: jnp.ndarray  # u32[L] accumulated MAX-cost bound
-    storage_key: jnp.ndarray  # u32[L, K, 16]
-    storage_val: jnp.ndarray  # u32[L, K, 16]
+    storage_key: jnp.ndarray  # u32[L, K*16] FLAT
+    storage_val: jnp.ndarray  # u32[L, K*16] FLAT
     storage_used: jnp.ndarray  # bool[L, K]
     ret_off: jnp.ndarray  # i32[L] RETURN/REVERT data offset
     ret_len: jnp.ndarray  # i32[L]
@@ -185,14 +185,17 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "trap_op": ((L,), np.int32),
         "pc": ((L,), np.int32),
         "code_id": ((L,), np.int32),
-        "stack": ((L, S, D), np.uint32),
+        # stack/storage word planes are FLAT like tape_imm (row i =
+        # cols [i*D, (i+1)*D)): one canonical 2D layout for the fork
+        # gather; engine/step reshapes 3D views over the same bytes
+        "stack": ((L, S * D), np.uint32),
         "sp": ((L,), np.int32),
         "memory": ((L, M), np.uint8),
         "mem_words": ((L,), np.int32),
         "gas_left": ((L,), np.uint32),
         "gas_spent_max": ((L,), np.uint32),
-        "storage_key": ((L, K, D), np.uint32),
-        "storage_val": ((L, K, D), np.uint32),
+        "storage_key": ((L, K * D), np.uint32),
+        "storage_val": ((L, K * D), np.uint32),
         "storage_used": ((L, K), np.bool_),
         "ret_off": ((L,), np.int32),
         "ret_len": ((L,), np.int32),
@@ -430,9 +433,11 @@ def _fill_lane(
     if storage:
         if len(storage) > np_batch["storage_used"].shape[1]:
             raise ValueError("storage exceeds batch slot capacity")
+        key3 = np_batch["storage_key"][lane].reshape(-1, words.NDIGITS)
+        val3 = np_batch["storage_val"][lane].reshape(-1, words.NDIGITS)
         for j, (k, v) in enumerate(sorted(storage.items())):
-            np_batch["storage_key"][lane, j] = words.from_int(k)
-            np_batch["storage_val"][lane, j] = words.from_int(v)
+            key3[j] = words.from_int(k)  # view write-through
+            val3[j] = words.from_int(v)
             np_batch["storage_used"][lane, j] = True
 
 
@@ -515,8 +520,8 @@ def read_storage_dict(st: StateBatch, lane: int) -> dict:
     Use read_storage_full when the lane ran symbolically.
     """
     used = np.asarray(st.storage_used)[lane]
-    keys = np.asarray(st.storage_key)[lane]
-    vals = np.asarray(st.storage_val)[lane]
+    keys = np.asarray(st.storage_key)[lane].reshape(-1, words.NDIGITS)
+    vals = np.asarray(st.storage_val)[lane].reshape(-1, words.NDIGITS)
     ksym = np.asarray(st.skey_sym)[lane]
     vsym = np.asarray(st.sval_sym)[lane]
     return {
@@ -533,8 +538,8 @@ def read_storage_full(st: StateBatch, lane: int):
     the tape node (1-based id, see read_tape) is authoritative.
     """
     used = np.asarray(st.storage_used)[lane]
-    keys = np.asarray(st.storage_key)[lane]
-    vals = np.asarray(st.storage_val)[lane]
+    keys = np.asarray(st.storage_key)[lane].reshape(-1, words.NDIGITS)
+    vals = np.asarray(st.storage_val)[lane].reshape(-1, words.NDIGITS)
     ksym = np.asarray(st.skey_sym)[lane]
     vsym = np.asarray(st.sval_sym)[lane]
     return [

@@ -342,6 +342,7 @@ class DeviceBridge:
         # --- stack
         if len(mstate.stack) > self.cfg.stack_slots:
             raise PackError("stack exceeds capacity")
+        stack3 = np_batch["stack"][lane].reshape(-1, words.NDIGITS)
         for i, item in enumerate(mstate.stack):
             if isinstance(item, Bool):
                 # some host instructions leave raw Bools on the stack
@@ -353,9 +354,9 @@ class DeviceBridge:
                     symbol_factory.BitVecVal(0, 256),
                 )
             if isinstance(item, int):
-                np_batch["stack"][lane, i] = _word(item)
+                stack3[i] = _word(item)  # view write-through
             elif item.symbolic is False:
-                np_batch["stack"][lane, i] = _word(item.value)
+                stack3[i] = _word(item.value)
             else:
                 np_batch["stack_sym"][lane, i] = lower_top(item)
         np_batch["sp"][lane] = len(mstate.stack)
@@ -423,17 +424,19 @@ class DeviceBridge:
         entries = list(storage.printable_storage.items())
         if len(entries) > self.cfg.storage_slots:
             raise PackError("storage exceeds slot capacity")
+        key3 = np_batch["storage_key"][lane].reshape(-1, words.NDIGITS)
+        val3 = np_batch["storage_val"][lane].reshape(-1, words.NDIGITS)
         for j, (k_bv, v_bv) in enumerate(entries):
             if k_bv.symbolic:
                 np_batch["skey_sym"][lane, j] = lower_top(k_bv)
             else:
-                np_batch["storage_key"][lane, j] = _word(k_bv.value)
+                key3[j] = _word(k_bv.value)  # view write-through
             if isinstance(v_bv, int):
-                np_batch["storage_val"][lane, j] = _word(v_bv)
+                val3[j] = _word(v_bv)
             elif v_bv.symbolic:
                 np_batch["sval_sym"][lane, j] = lower_top(v_bv)
             else:
-                np_batch["storage_val"][lane, j] = _word(v_bv.value)
+                val3[j] = _word(v_bv.value)
             np_batch["storage_used"][lane, j] = True
 
     # ------------------------------------------------------------------
@@ -894,7 +897,7 @@ class DeviceBridge:
 
         # stack
         sp = int(np.asarray(st.sp)[lane])
-        stack_words = np.asarray(st.stack)[lane]
+        stack_words = np.asarray(st.stack)[lane].reshape(-1, words.NDIGITS)
         stack_tags = np.asarray(st.stack_sym)[lane]
         new_stack = MachineStack()
         for i in range(sp):

@@ -114,14 +114,23 @@ def _mem_gas(old_words, new_words):
 
 
 def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
-    L, S, _ = st.stack.shape
+    D = words.NDIGITS
+    L = st.stack.shape[0]
+    S = st.stack.shape[1] // D
     M = st.memory.shape[1]
     C = st.calldata.shape[1]
-    K = st.storage_key.shape[1]
+    K = st.storage_key.shape[1] // D
     CL = cb.code.shape[1]
     T = st.tape_op.shape[1]
     P = st.path_id.shape[1]
     lane = jnp.arange(L)
+
+    # word planes are carried FLAT ([L, n*D]) so the whole-state fork
+    # gather sees one canonical 2D layout (see batch.tape_imm); the 3D
+    # views below are reshapes (bitcasts) of the same bytes
+    stack3 = st.stack.reshape(L, S, D)
+    skey3 = st.storage_key.reshape(L, K, D)
+    sval3 = st.storage_val.reshape(L, K, D)
 
     running = st.alive & (st.status == RUNNING)
 
@@ -140,7 +149,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
 
     def peek(k):
         idx = jnp.clip(st.sp - 1 - k, 0, S - 1)
-        return st.stack[lane, idx]
+        return stack3[lane, idx]
 
     def peek_sym(k):
         idx = jnp.clip(st.sp - 1 - k, 0, S - 1)
@@ -527,7 +536,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     key_match = st.storage_used & jnp.where(
         has_a[:, None],
         st.skey_sym == sym_a[:, None],
-        (st.skey_sym == 0) & jnp.all(st.storage_key == a[:, None, :], axis=-1),
+        (st.skey_sym == 0) & jnp.all(skey3 == a[:, None, :], axis=-1),
     )  # [L, K]
     found = jnp.any(key_match, axis=-1)
     # Aliasing guard: the syntactic-match model is justified by keccak
@@ -537,7 +546,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # probe (or vice versa), so a probe that misses in that situation
     # leaves the device model instead of silently answering.
     entry_big_conc = st.storage_used & (st.skey_sym == 0) & jnp.any(
-        st.storage_key[:, :, 8:] != 0, axis=-1
+        skey3[:, :, 8:] != 0, axis=-1
     )
     any_big_conc = jnp.any(entry_big_conc, axis=-1)
     any_sym_entry = jnp.any(st.storage_used & (st.skey_sym > 0), axis=-1)
@@ -549,7 +558,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
     sel_slot = jnp.argmax(key_match, axis=-1)
     loaded = jnp.where(
-        found[:, None], st.storage_val[lane, sel_slot], jnp.zeros_like(a)
+        found[:, None], sval3[lane, sel_slot], jnp.zeros_like(a)
     )
     loaded_sym = jnp.where(found, st.sval_sym[lane, sel_slot], 0)
     res = _sel(res, is_sload, loaded)
@@ -591,11 +600,11 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # symbolic keys zero the concrete plane (skey_sym is authoritative),
     # matching write_val's zeroed-placeholder contract
     write_key = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
-    new_storage_key = st.storage_key.at[lane, store_slot].set(
-        jnp.where(do_store[:, None], write_key, st.storage_key[lane, store_slot])
+    new_storage_key = skey3.at[lane, store_slot].set(
+        jnp.where(do_store[:, None], write_key, skey3[lane, store_slot])
     )
-    new_storage_val = st.storage_val.at[lane, store_slot].set(
-        jnp.where(do_store[:, None], write_val, st.storage_val[lane, store_slot])
+    new_storage_val = sval3.at[lane, store_slot].set(
+        jnp.where(do_store[:, None], write_val, sval3[lane, store_slot])
     )
     new_skey_sym = st.skey_sym.at[lane, store_slot].set(
         jnp.where(do_store, write_key_sym, st.skey_sym[lane, store_slot])
@@ -767,7 +776,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     is_dup = (op >= 0x80) & (op <= 0x8F)
     k_dup = op - 0x7F  # DUPk copies stack[sp-k]
     dup_idx = jnp.clip(st.sp - k_dup, 0, S - 1)
-    dup_val = st.stack[lane, dup_idx]
+    dup_val = stack3[lane, dup_idx]
     dup_tag = st.stack_sym[lane, dup_idx]
     res = _sel(res, is_dup, dup_val)
 
@@ -932,11 +941,11 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # (post-pop) top; SWAP rearranges in place instead.
     produces = (pushes > 0) & ~is_swap
     write_idx = jnp.clip(new_sp - 1, 0, S - 1)
-    stack_after = st.stack.at[lane, write_idx].set(
+    stack_after = stack3.at[lane, write_idx].set(
         jnp.where(
             (committed & produces)[:, None],
             res,
-            st.stack[lane, write_idx],
+            stack3[lane, write_idx],
         )
     )
     stack_sym_after = st.stack_sym.at[lane, write_idx].set(
@@ -944,8 +953,8 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
     # SWAP: two positional writes
     swap_mask = committed & is_swap
-    lo_val = st.stack[lane, swap_lo_idx]
-    hi_val = st.stack[lane, swap_hi_idx]
+    lo_val = stack3[lane, swap_lo_idx]
+    hi_val = stack3[lane, swap_hi_idx]
     lo_tag = st.stack_sym[lane, swap_lo_idx]
     hi_tag = st.stack_sym[lane, swap_hi_idx]
     stack_after = stack_after.at[lane, swap_lo_idx].set(
@@ -1040,14 +1049,14 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         ),
         pc=merge(new_pc, st.pc),
         code_id=st.code_id,
-        stack=merge(stack_after, st.stack),
+        stack=merge(stack_after, stack3).reshape(L, S * D),
         sp=merge(new_sp, st.sp),
         memory=merge(mem, st.memory),
         mem_words=merge(new_mem_words, st.mem_words),
         gas_left=merge(new_gas, st.gas_left, status_mask),
         gas_spent_max=merge(new_gas_max, st.gas_spent_max, status_mask),
-        storage_key=merge(new_storage_key, st.storage_key),
-        storage_val=merge(new_storage_val, st.storage_val),
+        storage_key=merge(new_storage_key, skey3).reshape(L, K * D),
+        storage_val=merge(new_storage_val, sval3).reshape(L, K * D),
         storage_used=merge(new_storage_used, st.storage_used),
         ret_off=merge(new_ret_off, st.ret_off, status_mask),
         ret_len=merge(new_ret_len, st.ret_len, status_mask),
