@@ -357,9 +357,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     imm_alu = jnp.where(
         both_or_unary[:, None], jnp.zeros_like(a), jnp.where(has_a[:, None], b, a)
     )
-    tapes, alu_id, alu_ok = symtape.alloc(
-        tapes, alu_sym_mask, sym_opt, node_a, node_b, imm_alu, alloc_meta
-    )
+    # (allocation deferred: all non-SHA tape allocs of the step run as
+    # ONE gated group — see "combined tape allocation" below. Every
+    # lax.cond costs operand-copy overhead each iteration even when the
+    # branch never fires, so six alloc sites collapse into one.)
 
     # ------------------------------------------------------------------
     # environment / block pushes
@@ -403,10 +404,6 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     env_imm = jnp.where(
         (is_blockhash & ~has_a)[:, None], a, jnp.zeros_like(a)
     )
-    tapes, env_leaf_id, env_ok = symtape.alloc(
-        tapes, env_leaf_mask, env_leaf_op, env_node_a, zero, env_imm,
-        alloc_meta,
-    )
 
     # ------------------------------------------------------------------
     # CALLDATALOAD / MLOAD: ONE shared 32-byte gather. Per-lane byte
@@ -446,15 +443,6 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     cdload_sym_mask = ok_lane & is_cdload & st.calldata_symbolic
     cd_node_a = jnp.where(has_a, sym_a, symtape.ARG_IMM)
     cd_imm = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
-    tapes, cdload_id, cdload_ok = symtape.alloc(
-        tapes,
-        cdload_sym_mask,
-        jnp.full((L,), symtape.OP_CDLOAD, I32),
-        cd_node_a,
-        zero,
-        cd_imm,
-        alloc_meta,
-    )
     # symbolic offset into CONCRETE calldata: data-dependent gather, host's job
     cdload_symoff_trap = is_cdload & has_a & ~st.calldata_symbolic
 
@@ -575,16 +563,6 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     )
     skey_node_a = jnp.where(has_a, sym_a, symtape.ARG_IMM)
     skey_imm = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
-    tapes, sload_leaf_id, sload_ok = symtape.alloc(
-        tapes,
-        sload_leaf_mask,
-        jnp.full((L,), symtape.OP_SLOAD, I32),
-        skey_node_a,
-        zero,
-        skey_imm,
-        alloc_meta,
-    )
-    sload_tag = jnp.where(found, loaded_sym, jnp.where(sload_leaf_mask, sload_leaf_id, 0))
 
     all_used = jnp.all(st.storage_used, axis=-1)
     first_free = jnp.argmin(st.storage_used, axis=-1)
@@ -592,6 +570,92 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     need_insert = (is_sstore | sload_leaf_mask) & ~found
     storage_trap = (need_insert & all_used) | storage_alias_trap
     do_store = ok_lane & (is_sstore | sload_leaf_mask) & ~storage_trap & ~sym_key_trap
+
+    # storage-event masks (the event ids resolve after the combined
+    # alloc below). Concrete keys/values ride as CONST tape nodes so the
+    # replayed hooks see exact words (key aliasing for the pruner, the
+    # arbitrary-write sentinel, constant-operand hazards), not zero
+    # placeholders.
+    ev_sload = (
+        ok_lane
+        & is_sload
+        & ~storage_trap
+        & ~sym_key_trap
+        & ~storage_alias_trap
+    )
+    ev_base = (ev_sload | (do_store & is_sstore)) & cb.record_storage_events
+    const_key_mask = ev_base & ~has_a
+    const_val_mask = ev_base & is_sstore & ~has_b
+
+    # ------------------------------------------------------------------
+    # combined tape allocation: every non-SHA alloc site of the step
+    # under ONE any-lane cond. A lane executes one opcode per step, so
+    # the ALU / env-leaf / CDLOAD-leaf / SLOAD-leaf sites are mutually
+    # exclusive and merge into one alloc (group A); only the storage
+    # event ring can add a second/third node on the same lane (CONST key
+    # then CONST value), which run as two more UNGATED allocs inside the
+    # same cond. Six lax.conds collapse to one: each cond pays operand
+    # copies every iteration even when its branch never fires.
+    ga_mask = alu_sym_mask | env_leaf_mask | cdload_sym_mask | sload_leaf_mask
+    ga_op = jnp.where(
+        alu_sym_mask,
+        sym_opt,
+        jnp.where(
+            env_leaf_mask,
+            env_leaf_op,
+            jnp.where(cdload_sym_mask, symtape.OP_CDLOAD, symtape.OP_SLOAD),
+        ),
+    )
+    ga_a = jnp.where(
+        alu_sym_mask,
+        node_a,
+        jnp.where(
+            env_leaf_mask,
+            env_node_a,
+            jnp.where(cdload_sym_mask, cd_node_a, skey_node_a),
+        ),
+    )
+    ga_b = jnp.where(alu_sym_mask, node_b, 0)
+    ga_imm = jnp.where(
+        alu_sym_mask[:, None],
+        imm_alu,
+        jnp.where(
+            env_leaf_mask[:, None],
+            env_imm,
+            jnp.where(cdload_sym_mask[:, None], cd_imm, skey_imm),
+        ),
+    )
+    const_op = jnp.full((L,), symtape.OP_CONST, I32)
+    const_arg = jnp.full((L,), symtape.ARG_IMM, I32)
+
+    def do_allocs(tapes):
+        tapes, ga_id, ga_ok = symtape.alloc_ungated(
+            tapes, ga_mask, ga_op, ga_a, ga_b, ga_imm, alloc_meta
+        )
+        tapes, kc_id, kc_ok = symtape.alloc_ungated(
+            tapes, const_key_mask, const_op, const_arg, zero, a, alloc_meta
+        )
+        tapes, vc_id, vc_ok = symtape.alloc_ungated(
+            tapes, const_val_mask, const_op, const_arg, zero, b, alloc_meta
+        )
+        return tapes, ga_id, kc_id, vc_id, ga_ok & kc_ok & vc_ok
+
+    def skip_allocs(tapes):
+        z = jnp.zeros((L,), I32)
+        return tapes, z, z, z, jnp.ones((L,), jnp.bool_)
+
+    tapes, ga_id, key_const_id, val_const_id, group_alloc_ok = jax.lax.cond(
+        jnp.any(ga_mask | const_key_mask | const_val_mask),
+        do_allocs,
+        skip_allocs,
+        tapes,
+    )
+    alu_id = jnp.where(alu_sym_mask, ga_id, 0)
+    env_leaf_id = jnp.where(env_leaf_mask, ga_id, 0)
+    cdload_id = jnp.where(cdload_sym_mask, ga_id, 0)
+    sload_leaf_id = jnp.where(sload_leaf_mask, ga_id, 0)
+
+    sload_tag = jnp.where(found, loaded_sym, jnp.where(sload_leaf_mask, sload_leaf_id, 0))
     # symbolic values zero the concrete plane (sval_sym is authoritative),
     # so host readers can never mistake a placeholder word for a write
     write_val = jnp.where((is_sstore & ~has_b)[:, None], b, jnp.zeros_like(b))
@@ -620,39 +684,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # (pc, key id, value id, is_load, jump count) so the bridge can
     # re-fire the skipped storage pre-hooks — and the dependency
     # pruner's block-entry bookkeeping — in EXACT execution order at
-    # lift time. Concrete keys/values ride as CONST tape nodes so the
-    # replayed hooks see exact words (key aliasing for the pruner, the
-    # arbitrary-write sentinel, constant-operand hazards), not zero
-    # placeholders. Overflow freeze-traps: exact events matter.
-    ev_sload = (
-        ok_lane
-        & is_sload
-        & ~storage_trap
-        & ~sym_key_trap
-        & ~storage_alias_trap
-    )
-    ev_base = (ev_sload | (do_store & is_sstore)) & cb.record_storage_events
-    const_key_mask = ev_base & ~has_a
-    tapes, key_const_id, key_const_ok = symtape.alloc(
-        tapes,
-        const_key_mask,
-        jnp.full((L,), symtape.OP_CONST, I32),
-        jnp.full((L,), symtape.ARG_IMM, I32),
-        zero,
-        a,
-        alloc_meta,
-    )
-    const_val_mask = ev_base & is_sstore & ~has_b
-    tapes, val_const_id, val_const_ok = symtape.alloc(
-        tapes,
-        const_val_mask,
-        jnp.full((L,), symtape.OP_CONST, I32),
-        jnp.full((L,), symtape.ARG_IMM, I32),
-        zero,
-        b,
-        alloc_meta,
-    )
-    const_ok = key_const_ok & val_const_ok
+    # lift time. Overflow freeze-traps: exact events matter.
     ev_key_id = jnp.where(has_a, sym_a, key_const_id)
     ev_val_id = jnp.where(is_sstore, jnp.where(has_b, sym_b, val_const_id), 0)
 
@@ -845,7 +877,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
 
     # ------------------------------------------------------------------
     # status resolution (order matters)
-    alloc_trap = ~(alu_ok & cdload_ok & sload_ok & sha_ok & env_ok & const_ok)
+    alloc_trap = ~(group_alloc_ok & sha_ok)
     sym_trap = (
         jump_dest_sym_trap
         | (modal & (has_a | has_b | has_c))

@@ -213,6 +213,16 @@ def alloc(tapes, mask, op, a, b, imm, meta):
     )
 
 
+def alloc_ungated(tapes, mask, op, a, b, imm, meta):
+    """:func:`alloc` without the any-lane cond gate.
+
+    For callers that already run under their own gate (the step kernel's
+    combined-allocation block fires several allocs inside ONE cond —
+    engine.py), so the per-site cond's operand-copy overhead is not paid
+    again. Same contract as :func:`alloc` otherwise."""
+    return _alloc_impl(tapes, mask, op, a, b, imm, meta)
+
+
 def _alloc_impl(tapes, mask, op, a, b, imm, meta):
     (
         tape_op, tape_a, tape_b, tape_imm, tape_h1, tape_h2,
