@@ -138,7 +138,7 @@ def _host_states_per_sec(creation_hex: str, budget_s: float = 20.0) -> float:
         if laser.total_states >= 50 or budget != budget_s:
             return laser.total_states / dt
         _phase(f"  host baseline starved ({laser.total_states} states); retrying")
-    return laser.total_states / dt
+    raise AssertionError("unreachable: retry iteration always returns")
 
 
 def _device_states_per_sec(code: bytes, lanes: int) -> float:
@@ -280,7 +280,7 @@ def _watchdog_main() -> int:
     overall deadline, and ALWAYS print one metric JSON line — a wedged
     accelerator tunnel (blocked C recv, uninterruptible) must not turn
     the whole bench into a silent timeout."""
-    deadline = float(os.environ.get("MYTHRIL_BENCH_DEADLINE", "1500"))
+    deadline = float(os.environ.get("MYTHRIL_BENCH_DEADLINE", "2100"))
     # pid-scoped path: concurrent benches in one directory must not
     # clobber (or later read) each other's checkpoints
     progress_path = os.path.abspath(f"._bench_progress.{os.getpid()}.json")
@@ -291,6 +291,7 @@ def _watchdog_main() -> int:
     env = dict(os.environ)
     env["MYTHRIL_BENCH_CHILD"] = "1"
     env["MYTHRIL_BENCH_PROGRESS"] = progress_path
+    ok = False
     try:
         rc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
@@ -298,10 +299,18 @@ def _watchdog_main() -> int:
             env=env,
         ).returncode
         if rc == 0:
+            ok = True
             return 0  # child printed the JSON line itself
         _phase(f"child exited rc={rc}; emitting partial results")
     except subprocess.TimeoutExpired:
         _phase(f"deadline {deadline}s hit; emitting partial results")
+    finally:
+        if ok:
+            for p in (progress_path, progress_path + ".tmp"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
     progress = {}
     try:
         with open(progress_path) as f:
@@ -382,7 +391,10 @@ def main() -> int:
         + bec_runtime.hex()
     )
     _phase("host baseline (BECToken)")
-    bec_host_rate = _host_states_per_sec(bec_creation)
+    # BECToken needs a real budget: at 20s the host baseline barely
+    # clears contract creation and the denominator turns the ratio
+    # absurd (the 120s-budget harness measures ~11 states/s)
+    bec_host_rate = _host_states_per_sec(bec_creation, budget_s=90.0)
     progress["bectoken_host_states_per_sec"] = bec_host_rate
     _checkpoint(progress)
     _phase("integrated tpu-batch pipeline (BECToken)")
