@@ -973,33 +973,31 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # (post-pop) top; SWAP rearranges in place instead.
     produces = (pushes > 0) & ~is_swap
     write_idx = jnp.clip(new_sp - 1, 0, S - 1)
-    stack_after = stack3.at[lane, write_idx].set(
-        jnp.where(
-            (committed & produces)[:, None],
-            res,
-            stack3[lane, write_idx],
-        )
-    )
-    stack_sym_after = st.stack_sym.at[lane, write_idx].set(
-        jnp.where(committed & produces, res_sym, st.stack_sym[lane, write_idx])
-    )
-    # SWAP: two positional writes
+    # A producing op and a SWAP are mutually exclusive per lane
+    # (produces excludes is_swap), so the value write and the two swap
+    # writes fold into ONE two-column scatter per plane: column 0 is
+    # either the produced top or the swapped-low slot, column 1 only
+    # exists for SWAP. Out-of-range index S drops a column's write.
     swap_mask = committed & is_swap
+    wr_mask = committed & produces
     lo_val = stack3[lane, swap_lo_idx]
     hi_val = stack3[lane, swap_hi_idx]
     lo_tag = st.stack_sym[lane, swap_lo_idx]
     hi_tag = st.stack_sym[lane, swap_hi_idx]
-    stack_after = stack_after.at[lane, swap_lo_idx].set(
-        jnp.where(swap_mask[:, None], hi_val, stack_after[lane, swap_lo_idx])
+    col0_idx = jnp.where(swap_mask, swap_lo_idx, jnp.where(wr_mask, write_idx, S))
+    col1_idx = jnp.where(swap_mask, swap_hi_idx, S)
+    stack_idx2 = jnp.stack([col0_idx, col1_idx], axis=1)  # [L, 2]
+    stack_val2 = jnp.stack(
+        [jnp.where(swap_mask[:, None], hi_val, res), lo_val], axis=1
+    )  # [L, 2, 16]
+    stack_tag2 = jnp.stack(
+        [jnp.where(swap_mask, hi_tag, res_sym), lo_tag], axis=1
+    )  # [L, 2]
+    stack_after = stack3.at[lane[:, None], stack_idx2].set(
+        stack_val2, mode="drop"
     )
-    stack_after = stack_after.at[lane, swap_hi_idx].set(
-        jnp.where(swap_mask[:, None], lo_val, stack_after[lane, swap_hi_idx])
-    )
-    stack_sym_after = stack_sym_after.at[lane, swap_lo_idx].set(
-        jnp.where(swap_mask, hi_tag, stack_sym_after[lane, swap_lo_idx])
-    )
-    stack_sym_after = stack_sym_after.at[lane, swap_hi_idx].set(
-        jnp.where(swap_mask, lo_tag, stack_sym_after[lane, swap_hi_idx])
+    stack_sym_after = st.stack_sym.at[lane[:, None], stack_idx2].set(
+        stack_tag2, mode="drop"
     )
 
     # ------------------------------------------------------------------
@@ -1081,7 +1079,8 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         ),
         pc=merge(new_pc, st.pc),
         code_id=st.code_id,
-        stack=merge(stack_after, stack3).reshape(L, S * D),
+        # stack writes are committed-gated scatters; no merge needed
+        stack=stack_after.reshape(L, S * D),
         sp=merge(new_sp, st.sp),
         memory=merge(mem, st.memory),
         mem_words=merge(new_mem_words, st.mem_words),
@@ -1125,7 +1124,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         ss_is_load=merge(new_ss_is_load, st.ss_is_load),
         ss_jd=merge(new_ss_jd, st.ss_jd),
         ss_cnt=merge(new_ss_cnt, st.ss_cnt),
-        stack_sym=merge(stack_sym_after, st.stack_sym),
+        stack_sym=stack_sym_after,
         # tape planes commit unconditionally: rows were written by masked
         # per-lane scatters, and a non-committing lane reverts via tape_len
         # alone — rows at or beyond tape_len are dead by invariant (the CSE
