@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Verify the jax persistent compile cache works over the axon remote-compile
+path (never confirmed before the round-4 tunnel death; see docs/PERF_NOTES.md).
+
+Times one distinctive jit compile in THIS process and prints one JSON line:
+  {"platform": ..., "compile_s": N, "salt": ...}
+Run it twice in fresh processes with the same salt: if the second run's
+compile_s collapses (~10x+ faster), the persistent cache round-trips the
+tunnel's remote compile.  Usage: python3 scripts/cache_probe.py [salt]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mythril_tpu.laser.tpu import ensure_compile_cache
+
+ensure_compile_cache()
+
+import jax
+import jax.numpy as jnp
+
+salt = float(sys.argv[1]) if len(sys.argv) > 1 else 5.0
+platform = jax.devices()[0].platform
+
+
+def probe(x):
+    # distinctive enough not to collide with any kernel the framework
+    # compiles; salt keys the cache entry per probe campaign
+    for _ in range(4):
+        x = jnp.sin(x @ x.T) * salt + jnp.cos(x).sum(axis=0)
+    return x.sum()
+
+
+x = jnp.ones((384, 384), jnp.float32)
+t0 = time.time()
+compiled = jax.jit(probe).lower(x).compile()
+compile_s = time.time() - t0
+r = float(compiled(x))
+print(
+    json.dumps(
+        {
+            "platform": platform,
+            "compile_s": round(compile_s, 3),
+            "salt": salt,
+            "result_ok": bool(abs(r) >= 0.0),
+            "cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        }
+    ),
+    flush=True,
+)

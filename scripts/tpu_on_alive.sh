@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-5 on-chip measurement campaign. Run ONCE when the axon tunnel is
+# alive (scripts/tpu_watch_r5.sh invokes this). Ordered by VERDICT r4
+# priority: integrated pipeline numbers first (never yet captured on
+# TPU), lane-scaling/roofline after. Every phase logs to OUT so a
+# mid-campaign tunnel death still leaves partial artifacts.
+set -u
+cd /root/repo
+OUT=/root/repo/.tpu_r5
+mkdir -p "$OUT"
+exec >>"$OUT/campaign.log" 2>&1
+echo "=== campaign start $(date +%F_%T) ==="
+
+mark() { echo "[$(date +%H:%M:%S)] $*"; }
+
+# Phase 0: persistent-compile-cache verification over the tunnel
+# (open question from r4). Two fresh processes, same salt.
+mark "phase 0: cache probe (cold)"
+timeout 900 python3 scripts/cache_probe.py 5.0 >"$OUT/cache_cold.json"
+mark "phase 0: cache probe (warm)"
+timeout 900 python3 scripts/cache_probe.py 5.0 >"$OUT/cache_warm.json"
+cat "$OUT/cache_cold.json" "$OUT/cache_warm.json"
+
+# Phase 1: THE product numbers on chip — bench.py (driver metric line:
+# integrated_vs_host + bectoken_vs_host, platform:tpu). Generous
+# deadline: tunnel compiles cost minutes.
+mark "phase 1: bench.py on TPU"
+MYTHRIL_BENCH_DEADLINE=4500 timeout 4800 python3 bench.py >"$OUT/BENCH_TPU.json"
+mark "phase 1 rc=$?"
+cat "$OUT/BENCH_TPU.json"
+
+# Phase 2: full BASELINE table on chip (all rows incl. the two that lose
+# to host on CPU).
+mark "phase 2: measure_baseline on TPU"
+timeout 4800 python3 scripts/measure_baseline.py --budget 90 >"$OUT/baseline_rows.jsonl"
+mark "phase 2 rc=$?"
+[ -f BASELINE_MEASURED.json ] && cp BASELINE_MEASURED.json "$OUT/BASELINE_TPU.json"
+
+# Phase 3: kernel lane scaling for the roofline artifact (VERDICT #5).
+for L in 8192 16384 32768; do
+  mark "phase 3: tpu_probe lanes=$L"
+  timeout 1800 python3 scripts/tpu_probe.py "$L" 256 >"$OUT/kernel_${L}.txt"
+  tail -1 "$OUT/kernel_${L}.txt"
+done
+mark "phase 3b: hlo_probe 8192"
+timeout 1800 python3 scripts/hlo_probe.py 8192 >"$OUT/hlo_8192.txt"
+
+# Commit artifacts only (never the working tree: the builder session may
+# be mid-edit).
+mark "committing artifacts"
+cp "$OUT/BASELINE_TPU.json" BASELINE_TPU.json 2>/dev/null || true
+git add -f .tpu_r5 BASELINE_TPU.json 2>/dev/null
+git commit -m "Capture round-5 on-chip measurement campaign artifacts" -- .tpu_r5 BASELINE_TPU.json || true
+touch "$OUT/DONE"
+mark "campaign complete"

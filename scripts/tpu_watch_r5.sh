@@ -1,0 +1,26 @@
+#!/bin/bash
+# Poll for axon tunnel revival and fire the round-5 measurement campaign
+# exactly once. Cheap port check first (relay listens on 127.0.0.1:8082;
+# when it is dead jax.devices() HANGS >120s, so avoid probing jax until
+# the port is back).
+set -u
+OUT=/root/repo/.tpu_r5
+mkdir -p "$OUT"
+exec >>"$OUT/watch.log" 2>&1
+while true; do
+  if [ -f "$OUT/DONE" ]; then echo "$(date +%H:%M:%S) campaign done; exiting"; exit 0; fi
+  if ss -tln 2>/dev/null | grep -q ':8082 '; then
+    echo "$(date +%H:%M:%S) port 8082 up; probing jax"
+    if timeout 240 python3 -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d"; then
+      echo "$(date +%H:%M:%S) TUNNEL ALIVE — launching campaign"
+      bash /root/repo/scripts/tpu_on_alive.sh
+      echo "$(date +%H:%M:%S) campaign rc=$?"
+      exit 0
+    else
+      echo "$(date +%H:%M:%S) port up but jax probe failed"
+    fi
+  else
+    echo "$(date +%H:%M:%S) tunnel dead (no :8082)"
+  fi
+  sleep 60
+done
