@@ -13,6 +13,14 @@ number is EVM machine-states advanced per second — one state-advance =
 one instruction evaluated on one path, the unit the reference's
 `total_states` counter tracks (svm.py:81).
 
+All engine comparisons follow benchmark protocol v1
+(mythril_tpu/support/benchmeter.py): both engines run the identical
+product pipeline (SymExecWrapper + detection + witness solving) and the
+measured window excludes contract creation — it opens at the first
+message-call transaction round and closes after fire_lasers. The
+BECToken phase uses the exact BASELINE bectoken_t3 row config (tx=3,
+budget=120) so this harness and scripts/measure_baseline.py must agree.
+
 The TPU side replays the same contract over thousands of lanes with
 divergent calldata (path enumeration) through the fused step kernel.
 """
@@ -118,27 +126,49 @@ revert:
 """
 
 
-def _host_states_per_sec(creation_hex: str, budget_s: float = 20.0) -> float:
-    from mythril_tpu.laser.evm.svm import LaserEVM
-    from mythril_tpu.laser.evm.strategy.basic import BreadthFirstSearchStrategy
+def _steady_analysis(
+    creation_hex: str,
+    runtime_hex: str,
+    strategy: str,
+    tx: int,
+    budget_s: int,
+    name: str,
+):
+    """Benchmark protocol v1: one full product analysis (SymExecWrapper +
+    detection + witness solving) measured with the SteadyStateMeter —
+    the window opens at the first message-call round (creation excluded)
+    and closes after fire_lasers, for BOTH engines identically.  Returns
+    (meter, sorted swc ids)."""
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+    from mythril_tpu.support.benchmeter import SteadyStateMeter
 
-    for budget in (budget_s, 3 * budget_s):
-        laser = LaserEVM(
-            strategy=BreadthFirstSearchStrategy,
-            transaction_count=2,
-            execution_timeout=budget,
-            max_depth=128,
-        )
-        t0 = time.time()
-        laser.sym_exec(creation_code=creation_hex, contract_name="BECStress")
-        dt = max(time.time() - t0, 1e-9)
-        # a loaded machine can starve the creation tx inside the budget,
-        # leaving a near-zero denominator that turns the ratios absurd;
-        # one retry with triple budget before accepting the number
-        if laser.total_states >= 50 or budget != budget_s:
-            return laser.total_states / dt
-        _phase(f"  host baseline starved ({laser.total_states} states); retrying")
-    raise AssertionError("unreachable: retry iteration always returns")
+    if strategy == "tpu-batch":
+        import mythril_tpu.laser.tpu.backend as backend
+
+        # compile the device kernels before the clock starts: the
+        # measured number is pipeline throughput, not XLA compile latency
+        _phase("  warmup_device(DEFAULT_BATCH_CFG)")
+        backend.warmup_device(backend.DEFAULT_BATCH_CFG)
+        _phase("  warm")
+
+    contract = EVMContract(
+        code=runtime_hex, creation_code=creation_hex, name=name
+    )
+    meter = SteadyStateMeter()
+    sym = SymExecWrapper(
+        contract,
+        address=0x1234,
+        strategy=strategy,
+        execution_timeout=budget_s,
+        transaction_count=tx,
+        max_depth=128,
+        pre_exec_hook=meter.install,
+    )
+    issues = fire_lasers(sym)
+    meter.close()
+    return meter, sorted({i.swc_id for i in issues})
 
 
 def _device_states_per_sec(code: bytes, lanes: int) -> float:
@@ -188,43 +218,6 @@ def _device_states_per_sec(code: bytes, lanes: int) -> float:
     return float(np.asarray(out.steps).sum()) / dt
 
 
-def _integrated_pipeline(
-    creation_hex: str, runtime_hex: str, budget_s: int = 60, name="BECStress"
-):
-    """The PRODUCT number: full tpu-batch analysis (device engine + batched
-    feasibility + detection modules + witness solving) on the stress
-    contract. Returns (states/s incl. device-retired, issue SWC ids)."""
-    import mythril_tpu.laser.tpu.backend as backend
-    from mythril_tpu.analysis.security import fire_lasers
-    from mythril_tpu.analysis.symbolic import SymExecWrapper
-    from mythril_tpu.ethereum.evmcontract import EVMContract
-
-    contract = EVMContract(
-        code=runtime_hex, creation_code=creation_hex, name=name
-    )
-    # compile the device kernels before the clock starts: the measured
-    # number is the pipeline's throughput, not XLA's compile latency
-    _phase("  warmup_device(DEFAULT_BATCH_CFG)")
-    backend.warmup_device(backend.DEFAULT_BATCH_CFG)
-    _phase("  warm; analyzing")
-    t0 = time.time()
-    sym = SymExecWrapper(
-        contract,
-        address=0x1234,
-        strategy="tpu-batch",
-        execution_timeout=budget_s,
-        transaction_count=2,
-        max_depth=128,
-    )
-    issues = fire_lasers(sym)
-    dt = max(time.time() - t0, 1e-9)
-    strategy = backend.find_tpu_strategy(sym.laser.strategy)
-    states = sym.laser.total_states + (
-        strategy.device_steps_retired if strategy else 0
-    )
-    return states / dt, sorted({i.swc_id for i in issues})
-
-
 def _checkpoint(progress: dict) -> None:
     """Persist partial results so the watchdog parent can still emit a
     metric line if a later phase wedges the process (dead TPU tunnel)."""
@@ -237,9 +230,18 @@ def _checkpoint(progress: dict) -> None:
         os.replace(path + ".tmp", path)
 
 
+def _ratio(num, den):
+    """None (not an absurd 1e12x) whenever either side is missing: a
+    partial checkpoint that lost its host baseline must not fabricate a
+    ratio against a sentinel denominator."""
+    if num is None or den is None or den <= 0:
+        return None
+    return round(num / den, 2)
+
+
 def _emit(progress: dict) -> None:
-    host_rate = progress.get("host_states_per_sec") or 1e-9
-    bec_host = progress.get("bectoken_host_states_per_sec") or 1e-9
+    host_rate = progress.get("host_states_per_sec")
+    bec_host = progress.get("bectoken_host_states_per_sec")
     device_rate = progress.get("device_rate")
     integrated = progress.get("integrated_states_per_sec")
     bec_rate = progress.get("bectoken_states_per_sec")
@@ -249,27 +251,28 @@ def _emit(progress: dict) -> None:
                 "metric": "evm_states_per_sec_becstress",
                 "value": None if device_rate is None else round(device_rate, 1),
                 "unit": "states/s",
-                "vs_baseline": None
-                if device_rate is None
-                else round(device_rate / host_rate, 2),
-                "host_states_per_sec": round(host_rate, 1),
+                "vs_baseline": _ratio(device_rate, host_rate),
+                "protocol": "steady-state-v1",
+                "host_states_per_sec": None
+                if host_rate is None
+                else round(host_rate, 1),
                 "integrated_states_per_sec": None
                 if integrated is None
                 else round(integrated, 1),
-                "integrated_vs_host": None
-                if integrated is None
-                else round(integrated / host_rate, 2),
+                "integrated_vs_host": _ratio(integrated, host_rate),
                 "integrated_swcs": progress.get("integrated_swcs"),
+                "bectoken_host_states_per_sec": None
+                if bec_host is None
+                else round(bec_host, 1),
                 "bectoken_states_per_sec": None
                 if bec_rate is None
                 else round(bec_rate, 1),
-                "bectoken_vs_host": None
-                if bec_rate is None
-                else round(bec_rate / bec_host, 2),
+                "bectoken_vs_host": _ratio(bec_rate, bec_host),
                 "bectoken_swcs": progress.get("bectoken_swcs"),
                 "lanes": progress.get("lanes"),
                 "platform": progress.get("platform", "unknown"),
                 "partial": progress.get("partial", False),
+                "error": progress.get("error"),
             }
         )
     )
@@ -280,7 +283,7 @@ def _watchdog_main() -> int:
     overall deadline, and ALWAYS print one metric JSON line — a wedged
     accelerator tunnel (blocked C recv, uninterruptible) must not turn
     the whole bench into a silent timeout."""
-    deadline = float(os.environ.get("MYTHRIL_BENCH_DEADLINE", "2100"))
+    deadline = float(os.environ.get("MYTHRIL_BENCH_DEADLINE", "2400"))
     # pid-scoped path: concurrent benches in one directory must not
     # clobber (or later read) each other's checkpoints
     progress_path = os.path.abspath(f"._bench_progress.{os.getpid()}.json")
@@ -292,16 +295,17 @@ def _watchdog_main() -> int:
     env["MYTHRIL_BENCH_CHILD"] = "1"
     env["MYTHRIL_BENCH_PROGRESS"] = progress_path
     ok = False
+    child_rc = None
     try:
-        rc = subprocess.run(
+        child_rc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
             timeout=deadline,
             env=env,
         ).returncode
-        if rc == 0:
+        if child_rc == 0:
             ok = True
             return 0  # child printed the JSON line itself
-        _phase(f"child exited rc={rc}; emitting partial results")
+        _phase(f"child exited rc={child_rc}; emitting partial results")
     except subprocess.TimeoutExpired:
         _phase(f"deadline {deadline}s hit; emitting partial results")
     finally:
@@ -324,6 +328,14 @@ def _watchdog_main() -> int:
             except OSError:
                 pass
     progress["partial"] = True
+    if child_rc is not None and child_rc != 0:
+        # a crashed child (import error, assertion) is a real failure,
+        # distinct from a deadline-bounded partial run: mark the metric
+        # line AND propagate a nonzero exit so harnesses keying on
+        # status don't read breakage as success
+        progress["error"] = f"child rc={child_rc}"
+        _emit(progress)
+        return 1
     _emit(progress)
     return 0
 
@@ -347,10 +359,12 @@ def main() -> int:
     )
     creation_hex = assemble(creation_src).hex() + runtime.hex()
 
-    progress = {}
-    _phase("host baseline (stress contract)")
-    host_rate = _host_states_per_sec(creation_hex)
-    progress["host_states_per_sec"] = host_rate
+    progress = {"protocol": "steady-state-v1"}
+    _phase("host baseline (stress contract, bfs tx=2 budget=60)")
+    host_meter, _ = _steady_analysis(
+        creation_hex, runtime.hex(), "bfs", 2, 60, "BECStress"
+    )
+    progress["host_states_per_sec"] = host_meter.states_per_s
     _checkpoint(progress)
 
     import jax
@@ -365,18 +379,20 @@ def main() -> int:
     progress["device_rate"] = device_rate
     _checkpoint(progress)
 
-    _phase("integrated tpu-batch pipeline (stress contract)")
-    integrated_rate, integrated_swcs = _integrated_pipeline(
-        creation_hex, runtime.hex()
+    _phase("integrated tpu-batch pipeline (stress contract, tx=2 budget=60)")
+    meter, integrated_swcs = _steady_analysis(
+        creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
     )
-    progress["integrated_states_per_sec"] = integrated_rate
+    progress["integrated_states_per_sec"] = meter.states_per_s
     progress["integrated_swcs"] = integrated_swcs
     _checkpoint(progress)
 
     # the BASELINE.md north-star workload: the faithful BECToken
     # batchTransfer reproduction (bench_contracts/bectoken.asm — no solc
     # in this image, see the .asm header), through the same product
-    # pipeline. SWC-101 is the CVE-2018-10299 overflow.
+    # pipeline, at the BASELINE row's exact config (tx=3, budget=120 —
+    # identical to measure_baseline.py's bectoken_t3 row so the two
+    # harnesses must agree). SWC-101 is the CVE-2018-10299 overflow.
     bec_src = open(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "bench_contracts", "bectoken.asm")
@@ -390,18 +406,17 @@ def main() -> int:
         ).hex()
         + bec_runtime.hex()
     )
-    _phase("host baseline (BECToken)")
-    # BECToken needs a real budget: at 20s the host baseline barely
-    # clears contract creation and the denominator turns the ratio
-    # absurd (the 120s-budget harness measures ~11 states/s)
-    bec_host_rate = _host_states_per_sec(bec_creation, budget_s=90.0)
-    progress["bectoken_host_states_per_sec"] = bec_host_rate
-    _checkpoint(progress)
-    _phase("integrated tpu-batch pipeline (BECToken)")
-    bec_rate, bec_swcs = _integrated_pipeline(
-        bec_creation, bec_runtime.hex(), name="BECToken"
+    _phase("host baseline (BECToken, bfs tx=3 budget=120)")
+    bec_host_meter, _ = _steady_analysis(
+        bec_creation, bec_runtime.hex(), "bfs", 3, 120, "BECToken"
     )
-    progress["bectoken_states_per_sec"] = bec_rate
+    progress["bectoken_host_states_per_sec"] = bec_host_meter.states_per_s
+    _checkpoint(progress)
+    _phase("integrated tpu-batch pipeline (BECToken, tx=3 budget=120)")
+    bec_meter, bec_swcs = _steady_analysis(
+        bec_creation, bec_runtime.hex(), "tpu-batch", 3, 120, "BECToken"
+    )
+    progress["bectoken_states_per_sec"] = bec_meter.states_per_s
     progress["bectoken_swcs"] = bec_swcs
     _checkpoint(progress)
     _phase("done")

@@ -89,6 +89,7 @@ class SymExecWrapper:
         enable_coverage_strategy: bool = False,
         custom_modules_directory: str = "",
         checkpoint_dir: Optional[str] = None,
+        pre_exec_hook=None,
     ):
         # every analysis starts from a fresh incremental solver core:
         # clause-database growth from prior contracts/runs in the same
@@ -160,6 +161,11 @@ class SymExecWrapper:
         for account in self.accounts.values():
             world_state.put_account(account)
 
+        # measurement/instrumentation seam: called with the fully
+        # configured LaserEVM (plugins + detection hooks loaded) right
+        # before execution, e.g. to install a SteadyStateMeter
+        if pre_exec_hook is not None:
+            pre_exec_hook(self.laser)
         self._execute(contract, address, world_state, dynloader)
 
         if requires_statespace:

@@ -9,6 +9,7 @@
 // Loaded via ctypes (mythril_tpu/support/native_build.py). No pybind11 —
 // plain C ABI.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
@@ -137,12 +138,71 @@ struct Solver {
   double cla_inc = 1.0;
   bool ok = true;
   std::vector<int> seen;
-  // heap-free decision: cached order rebuilt lazily
-  std::vector<int> order;
-  size_t order_head = 0;
-  bool order_dirty = true;
+  // VSIDS decision order: indexed binary max-heap on activity with lazy
+  // deletion (the sort-based order this replaced re-sorted EVERY var on
+  // the first decide after any bump — O(n log n) per conflict, ~2M
+  // comparisons each on the 100k-var instances witness queries build;
+  // the heap makes it O(log n) per bumped var)
+  std::vector<int> heap;      // heap array of var indices
+  std::vector<int> heap_pos;  // var -> heap slot, -1 if absent
+  // cooperative cancellation for portfolio/deadline use; set from any
+  // thread via tsat_interrupt, polled once per conflict and every 1024
+  // decisions (the old every-64-conflicts poll made slices unreliable
+  // on propagation-heavy phases)
+  std::atomic<bool> interrupted{false};
 
   int lit_index(Lit l) const { return l > 0 ? 2 * l : 2 * (-l) + 1; }
+
+  bool heap_lt(int a, int b) const { return activity[a] > activity[b]; }
+
+  void heap_up(int i) {
+    int v = heap[i];
+    while (i > 0) {
+      int p = (i - 1) >> 1;
+      if (!heap_lt(v, heap[p])) break;
+      heap[i] = heap[p];
+      heap_pos[heap[i]] = i;
+      i = p;
+    }
+    heap[i] = v;
+    heap_pos[v] = i;
+  }
+
+  void heap_down(int i) {
+    int v = heap[i];
+    const int n = (int)heap.size();
+    for (;;) {
+      int l = 2 * i + 1;
+      if (l >= n) break;
+      int c = (l + 1 < n && heap_lt(heap[l + 1], heap[l])) ? l + 1 : l;
+      if (!heap_lt(heap[c], v)) break;
+      heap[i] = heap[c];
+      heap_pos[heap[i]] = i;
+      i = c;
+    }
+    heap[i] = v;
+    heap_pos[v] = i;
+  }
+
+  void heap_insert(int v) {
+    if (heap_pos[v] != -1) return;
+    heap_pos[v] = (int)heap.size();
+    heap.push_back(v);
+    heap_up(heap_pos[v]);
+  }
+
+  int heap_pop() {
+    int v = heap[0];
+    heap_pos[v] = -1;
+    int last = heap.back();
+    heap.pop_back();
+    if (!heap.empty()) {
+      heap[0] = last;
+      heap_pos[last] = 0;
+      heap_down(0);
+    }
+    return v;
+  }
 
   int new_var() {
     ++nvars;
@@ -153,7 +213,8 @@ struct Solver {
     phase.push_back(-1);
     seen.push_back(0);
     watches.resize(2 * nvars + 2);
-    order_dirty = true;
+    heap_pos.push_back(-1);
+    heap_insert(nvars - 1);
     return nvars;
   }
 
@@ -189,11 +250,11 @@ struct Solver {
         int v = std::abs(trail[i]) - 1;
         assign[v] = 0;
         reason[v] = -1;
+        heap_insert(v);  // unassigned vars must be decidable again
       }
       trail.resize(lim);
     }
     if (qhead > trail.size()) qhead = trail.size();
-    order_head = 0;
   }
 
   bool root_assign(Lit l) {
@@ -275,10 +336,11 @@ struct Solver {
   void bump_var(int v) {
     activity[v] += var_inc;
     if (activity[v] > 1e100) {
+      // uniform rescale preserves relative order: heap invariant holds
       for (int u = 0; u < nvars; ++u) activity[u] *= 1e-100;
       var_inc *= 1e-100;
     }
-    order_dirty = true;
+    if (heap_pos[v] != -1) heap_up(heap_pos[v]);
   }
 
   void analyze(int confl, std::vector<Lit>& learnt, int& bt_level, unsigned& lbd) {
@@ -347,23 +409,15 @@ struct Solver {
     }
   }
 
-  void rebuild_order() {
-    order.resize(nvars);
-    for (int v = 0; v < nvars; ++v) order[v] = v;
-    std::sort(order.begin(), order.end(),
-              [this](int a, int b) { return activity[a] > activity[b]; });
-    order_head = 0;
-    order_dirty = false;
-  }
-
   Lit decide() {
-    if (order_dirty) rebuild_order();
-    while (order_head < order.size()) {
-      int v = order[order_head];
+    // lazy deletion: assigned vars surface and get dropped; they
+    // re-enter the heap when cancel_until unassigns them
+    while (!heap.empty()) {
+      int v = heap_pop();
       if (assign[v] == 0) return phase[v] >= 0 ? (v + 1) : -(v + 1);
-      ++order_head;
     }
-    // order may be stale; full scan to be safe
+    // safety net (every unassigned var should be heap-resident): a full
+    // scan so an invariant slip degrades to slow, never to a bogus SAT
     for (int v = 0; v < nvars; ++v)
       if (assign[v] == 0) return phase[v] >= 0 ? (v + 1) : -(v + 1);
     return 0;
@@ -429,6 +483,7 @@ struct Solver {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1 << 30);
     long long conflicts = 0;
+    long long decisions = 0;
     int restart_idx = 0;
     long long restart_limit = 64 * luby(restart_idx);
     long long next_reduce = 4000;
@@ -475,7 +530,10 @@ struct Solver {
           cancel_until(0);
           return 0;
         }
-        if ((conflicts & 63) == 0 &&
+        // poll EVERY conflict: now() costs ~20ns against conflicts that
+        // cost microseconds, and the old every-64 gate made deadlines
+        // and interrupts unreliable on propagation-heavy stretches
+        if (interrupted.load(std::memory_order_relaxed) ||
             std::chrono::steady_clock::now() > deadline) {
           cancel_until(0);
           return 0;
@@ -502,6 +560,12 @@ struct Solver {
         }
         Lit l = decide();
         if (l == 0) return 10;
+        if ((++decisions & 1023) == 0 &&
+            (interrupted.load(std::memory_order_relaxed) ||
+             std::chrono::steady_clock::now() > deadline)) {
+          cancel_until(0);
+          return 0;
+        }
         trail_lim.push_back((int)trail.size());
         enqueue(l, -1);
       }
@@ -556,4 +620,22 @@ void tsat_model_copy(void* s, signed char* out, int n) {
   for (int v = 1; v <= limit; v++) out[v - 1] = (signed char)solver->assign[v - 1];
 }
 int tsat_ok(void* s) { return ((tsat::Solver*)s)->ok ? 1 : 0; }
+// Cooperative cancellation: safe to call from any thread while another
+// thread is inside tsat_solve; that solve returns 0 (UNKNOWN) at its
+// next poll point (every conflict / every 1024 decisions). The flag
+// stays set until cleared so a racing solve that starts late still
+// stops promptly.
+void tsat_interrupt(void* s) {
+  ((tsat::Solver*)s)->interrupted.store(true, std::memory_order_relaxed);
+}
+void tsat_clear_interrupt(void* s) {
+  ((tsat::Solver*)s)->interrupted.store(false, std::memory_order_relaxed);
+}
+// Decision-phase seeding (e.g. from the device solver's model): bias
+// the saved phase so the first descent follows a known-good assignment.
+void tsat_set_phase(void* s, int var, int sign) {
+  auto* solver = (tsat::Solver*)s;
+  if (var >= 1 && var <= solver->nvars)
+    solver->phase[var - 1] = sign >= 0 ? 1 : -1;
+}
 }

@@ -96,6 +96,9 @@ class TpuBatchStrategy(BasicSearchStrategy):
         self.batch_cfg = batch_cfg or DEFAULT_BATCH_CFG
         self.device_rounds = 0
         self.device_steps_retired = 0
+        # storage-ring spill drains performed mid-round (lanes that would
+        # have freeze-trapped at ring overflow before round 5)
+        self.ss_drains = 0
         # start compiling the device kernels NOW on a background thread:
         # the creation transaction and the first host rounds overlap the
         # XLA compile, and exec_batch switches to device rounds the
@@ -490,7 +493,59 @@ def _warn_mesh_stats_once() -> None:
 DEVICE_SLICE_STEPS = 512
 
 
-def _run_device(cb, st, cfg, want_stats=False, deadline=None):
+def _drain_ss_rings(bridge, st):
+    """Mid-round partial lift of full storage-event rings (VERDICT r4 #7).
+
+    Lanes whose ONLY stop reason is ring overflow (status TRAP_SS) get
+    their recorded events copied into the bridge's host-side spill chain
+    — tape node ids stay valid for the rest of the round, so the events
+    replay exactly at final lift, before the ring's — then resume on
+    device with an empty ring (status RUNNING, ss_cnt 0). The spill
+    token rides the ``spill_id`` plane so fork children inherit their
+    prefix (reference behavior being preserved: every SLOAD/SSTORE fires
+    its pre-hook exactly once, in order —
+    mythril/laser/ethereum/instructions.py:1470).
+    """
+    import jax.numpy as jnp
+
+    from mythril_tpu.laser.tpu.batch import RUNNING as _RUNNING
+    from mythril_tpu.laser.tpu.batch import TRAP_SS as _TRAP_SS
+
+    status = np.asarray(st.status)
+    alive = np.asarray(st.alive)
+    mask = alive & (status == _TRAP_SS)
+    if not mask.any():
+        return st
+    lanes = np.nonzero(mask)[0]
+    ss_cnt = np.asarray(st.ss_cnt)
+    ss_pc = np.asarray(st.ss_pc)
+    ss_key = np.asarray(st.ss_key)
+    ss_val = np.asarray(st.ss_val)
+    ss_is_load = np.asarray(st.ss_is_load)
+    ss_jd = np.asarray(st.ss_jd)
+    spill_id = np.asarray(st.spill_id).copy()
+    for lane in lanes:
+        n = int(ss_cnt[lane])
+        events = [
+            (
+                int(ss_pc[lane, j]),
+                int(ss_key[lane, j]),
+                int(ss_val[lane, j]),
+                bool(ss_is_load[lane, j]),
+                int(ss_jd[lane, j]),
+            )
+            for j in range(n)
+        ]
+        spill_id[lane] = bridge.spill_chain(int(spill_id[lane]), events)
+    dev_mask = jnp.asarray(mask)
+    return st._replace(
+        status=jnp.where(dev_mask, _RUNNING, st.status),
+        ss_cnt=jnp.where(dev_mask, 0, st.ss_cnt),
+        spill_id=jnp.asarray(spill_id),
+    )
+
+
+def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
     """Run the packed batch to quiescence: single-device fast path, or —
     with more than one visible device — lane-sharded SPMD over a mesh with
     occupancy-gated all-to-all rebalancing (SURVEY §5 distributed backend;
@@ -522,6 +577,10 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None):
                 hist = slice_hist if hist is None else hist + slice_hist
             else:
                 st = run(cb, default_env(), st, max_steps=DEVICE_SLICE_STEPS)
+            # slice boundary = host sync point: drain any lane stopped
+            # purely by storage-ring overflow and resume it on device
+            if bridge is not None:
+                st = _drain_ss_rings(bridge, st)
             # the quiescence fetch blocks on the slice just dispatched, so
             # the deadline check AFTER it has absorbed the slice's device
             # time — overshoot is bounded by one slice
@@ -548,6 +607,12 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None):
             n_shards=n_shards,
         )
         steps_done += MESH_STEPS_PER_ROUND
+        if bridge is not None:
+            drained = _drain_ss_rings(bridge, st)
+            if drained is not st:
+                # the replace built unsharded planes; restore the lane
+                # sharding before the next pjit round
+                st = mesh_lib.shard_batch(drained, mesh)
         if not bool(np.asarray(st.alive & (st.status == _RUNNING)).any()):
             break
         if deadline is not None and time.time() > deadline:
@@ -753,6 +818,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             cfg,
             want_stats=want_stats,
             deadline=budget_deadline,
+            bridge=bridge,
         )
         # device wall captured NOW: _run_device's quiescence fetches have
         # synced the final slice, and the download/dict-building below is
@@ -777,6 +843,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 laser.iprof.record_device_round(counts, device_wall)
         strategy.device_rounds += 1
         strategy.device_steps_retired += int(np.asarray(out.steps).sum())
+        strategy.ss_drains += bridge.ss_drain_count
 
         # measurement parity: instructions retired on device feed the same
         # coverage accounting the host's execute_state hook does

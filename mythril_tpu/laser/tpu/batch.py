@@ -18,6 +18,12 @@ Lanes carry a ``status`` machine word:
   5 TRAP  — lane hit something the device kernel doesn't model
             (CALL family, CREATE, storage overflow, oversized SHA3);
             the host engine unpacks the lane and continues it symbolically.
+  6 TRAP_SS — the storage-event ring filled and that is the ONLY reason
+            the lane stopped: the backend drains the ring to a host-side
+            spill buffer mid-round (keyed by the lane's spill_id chain)
+            and resumes the lane on device; at lift the spilled events
+            replay before the ring's. A TRAP_SS lane that is never
+            drained (round deadline) lifts exactly like TRAP.
 Dead lanes (alive=False) are free slots for JUMPI forking.
 """
 
@@ -29,6 +35,7 @@ import numpy as np
 from mythril_tpu.laser.tpu import symtape, words
 
 RUNNING, STOPPED, RETURNED, REVERTED, ERROR, TRAP = range(6)
+TRAP_SS = 6
 
 U32 = jnp.uint32
 I32 = jnp.int32
@@ -137,6 +144,7 @@ class StateBatch(NamedTuple):
     ss_is_load: jnp.ndarray  # bool[L, ss_ring] SLOAD (True) vs SSTORE
     ss_jd: jnp.ndarray  # i32[L, ss_ring] landing count when the event fired
     ss_cnt: jnp.ndarray  # i32[L] storage events retired on device
+    spill_id: jnp.ndarray  # i32[L] host spill-chain token for drained ring events (0 = none); fork-copied with the lane
     # ---- symbolic layer (laser/tpu/symtape.py). Tags are 1-based tape
     # ids; 0 = concrete (the word/byte planes are authoritative).
     stack_sym: jnp.ndarray  # i32[L, S]
@@ -217,6 +225,7 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "ss_is_load": ((L, cfg.ss_ring), np.bool_),
         "ss_jd": ((L, cfg.ss_ring), np.int32),
         "ss_cnt": ((L,), np.int32),
+        "spill_id": ((L,), np.int32),
         "stack_sym": ((L, S), np.int32),
         "tape_op": ((L, T), np.int32),
         "tape_a": ((L, T), np.int32),
@@ -405,6 +414,7 @@ def _fill_lane(
     np_batch["ss_is_load"][lane] = False
     np_batch["ss_jd"][lane] = 0
     np_batch["ss_cnt"][lane] = 0
+    np_batch["spill_id"][lane] = 0
     # symbolic layer resets
     for f in (
         "stack_sym", "tape_op", "tape_a", "tape_b", "tape_imm", "tape_h1",

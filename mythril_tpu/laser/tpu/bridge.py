@@ -54,6 +54,7 @@ from mythril_tpu.smt import (
     If,
     Not,
     ULT,
+    simplify,
     symbol_factory,
 )
 from mythril_tpu.smt import terms
@@ -166,6 +167,40 @@ class DeviceBridge:
         # wrapper ops (smt/bitvec_helper.py), same as the reference's
         # taint mechanism (mythril/laser/smt/expression.py annotations).
         self.pack_annotations: Dict[Tuple[int, int], set] = {}
+        # spill-chain token -> (prev_token, ordered (pc, key id, val id,
+        # is_load, jd) event tuples) drained from a lane's full storage
+        # ring mid-round (backend._drain_ss_rings). Chains share prefix
+        # storage (a re-drain stores only the NEW events under a fresh
+        # token pointing at its predecessor), so fork children — which
+        # copy the parent's spill_id plane on device — resolve their
+        # exact inherited prefix at O(chain) cost, not O(chain^2).
+        self._ss_spill: Dict[int, tuple] = {}
+        self._spill_next = 1
+        self.ss_drain_count = 0
+
+    # ------------------------------------------------------------------
+    # storage-ring spill
+
+    def spill_chain(self, prev_token: int, events: list) -> int:
+        """Store ``events`` as a chain link extending ``prev_token``;
+        returns the new token."""
+        token = self._spill_next
+        self._spill_next += 1
+        self._ss_spill[token] = (prev_token, events)
+        self.ss_drain_count += 1
+        return token
+
+    def spilled_events(self, token: int) -> list:
+        """The full ordered event list behind ``token`` (chain walk)."""
+        chunks = []
+        token = int(token)
+        while token:
+            token, events = self._ss_spill.get(token, (0, []))
+            chunks.append(events)
+        out = []
+        for events in reversed(chunks):
+            out.extend(events)
+        return out
 
     # ------------------------------------------------------------------
     # packing
@@ -1006,8 +1041,9 @@ class DeviceBridge:
         Ring overflow makes the order unreconstructable: entry hooks
         offering an on_device_overflow callback are told (the dependency
         pruner disables itself — sound, just slower), storage events
-        cannot have been lost (ss overflow freeze-traps the lane), and
-        the surviving events replay uninterleaved.
+        cannot have been lost (ss overflow either drains to the host
+        spill chain mid-round — replayed first, below — or freeze-traps
+        the lane), and the surviving events replay uninterleaved.
 
         PluginSkipState raised by an entry hook propagates: the caller
         drops the lifted state, mirroring the host pruner's
@@ -1039,6 +1075,22 @@ class DeviceBridge:
         ev_is_load = np.asarray(st.ss_is_load)[lane]
         ev_jd = np.asarray(st.ss_jd)[lane]
 
+        # events drained mid-round (ring overflow spill) replay FIRST:
+        # they happened before everything still in the ring, and their
+        # jd counts are <= the ring's, so the concatenation stays sorted
+        # for the landing-interleave merge below
+        events = self.spilled_events(int(np.asarray(st.spill_id)[lane]))
+        events = events + [
+            (
+                int(ev_pc[j]),
+                int(ev_key[j]),
+                int(ev_val[j]),
+                bool(ev_is_load[j]),
+                int(ev_jd[j]),
+            )
+            for j in range(ev_cnt)
+        ]
+
         zero = symbol_factory.BitVecVal(0, 256)
 
         def term(tag):
@@ -1049,19 +1101,18 @@ class DeviceBridge:
         instr_list = gs.environment.code.instruction_list
         saved_pc, saved_stack = gs.mstate.pc, gs.mstate.stack
 
-        def fire_storage(j: int) -> None:
-            pc_index = evm_util.get_instruction_index(instr_list, int(ev_pc[j]))
+        def fire_storage(event) -> None:
+            pc_byte, key_id, val_id, is_load, _jd = event
+            pc_index = evm_util.get_instruction_index(instr_list, pc_byte)
             if pc_index is None:
                 return
             gs.mstate.pc = pc_index
-            if ev_is_load[j]:
+            if is_load:
                 hooks = sload_hooks
-                gs.mstate.stack = MachineStack([term(int(ev_key[j]))])
+                gs.mstate.stack = MachineStack([term(key_id)])
             else:
                 hooks = sstore_hooks
-                gs.mstate.stack = MachineStack(
-                    [term(int(ev_val[j])), term(int(ev_key[j]))]
-                )
+                gs.mstate.stack = MachineStack([term(val_id), term(key_id)])
             with forced_hook_phase(prehook=True):
                 for hook in hooks:
                     try:
@@ -1081,12 +1132,12 @@ class DeviceBridge:
         event_j = 0
         try:
             for k, landing in enumerate(landings):
-                while event_j < ev_cnt and int(ev_jd[event_j]) <= k:
-                    fire_storage(event_j)
+                while event_j < len(events) and events[event_j][4] <= k:
+                    fire_storage(events[event_j])
                     event_j += 1
                 fire_entry(landing)
-            while event_j < ev_cnt:
-                fire_storage(event_j)
+            while event_j < len(events):
+                fire_storage(events[event_j])
                 event_j += 1
         finally:
             gs.mstate.pc = saved_pc

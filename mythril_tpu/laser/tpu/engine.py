@@ -46,6 +46,7 @@ from mythril_tpu.laser.tpu.batch import (
     RUNNING,
     STOPPED,
     TRAP,
+    TRAP_SS,
     CodeBank,
     Env,
     StateBatch,
@@ -878,7 +879,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # ------------------------------------------------------------------
     # status resolution (order matters)
     alloc_trap = ~(group_alloc_ok & sha_ok)
-    sym_trap = (
+    # ss_full_trap is kept OUT of the core disjunction: a lane stopped by
+    # ring overflow ALONE is drainable mid-round (the backend spills the
+    # ring host-side and resumes it on device, status TRAP_SS below)
+    sym_trap_core = (
         jump_dest_sym_trap
         | (modal & (has_a | has_b | has_c))
         | ((is_mload | is_mstore | is_mstore8) & has_a)
@@ -893,7 +897,6 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         | ms_ins_trap
         | mstore_conc_trap
         | mstore8_ovl_trap
-        | ss_full_trap
         | copy_ovl_trap
         | sha_sym_trap
         | alloc_trap
@@ -903,7 +906,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     is_host_op = cb.host_ops[op]
     freeze = cb.freeze_errors  # hybrid-loop mode: errors freeze for host replay
     err_cond = is_invalid | underflow | evm_overflow | jump_err
-    trap = (
+    # trap_rest = every stop reason EXCEPT ring overflow; trap derives
+    # from it so the two can never drift apart (a divergence would let a
+    # lane with some other trap plus a full ring resume as drainable)
+    trap_rest = (
         (
             is_trap_op
             | balance_trap
@@ -911,14 +917,18 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             | retcopy_trap
             | storage_trap
             | sha_trap
-            | sym_trap
+            | sym_trap_core
             | is_host_op
             | (model_overflow & ~evm_overflow)
         )
         & ~is_invalid
         & ~underflow
     ) | (freeze & err_cond)
+    trap = trap_rest | (ss_full_trap & ~is_invalid & ~underflow)
     hard_err = err_cond & ~freeze & ~trap
+    # drainable = the ring overflow is the ONLY reason this lane stops:
+    # without ss_full_trap the step would have committed normally
+    ss_drain = ss_full_trap & trap & ~trap_rest
 
     total_gas = static_gas + gas_mem + gas_sha
     charged = ~trap & ~hard_err
@@ -939,7 +949,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         ERROR,
         jnp.where(
             trap | frozen_oog,
-            TRAP,
+            jnp.where(ss_drain, TRAP_SS, TRAP),
             jnp.where(
                 is_stop,
                 STOPPED,
@@ -1124,6 +1134,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         ss_is_load=merge(new_ss_is_load, st.ss_is_load),
         ss_jd=merge(new_ss_jd, st.ss_jd),
         ss_cnt=merge(new_ss_cnt, st.ss_cnt),
+        spill_id=st.spill_id,
         stack_sym=stack_sym_after,
         # tape planes commit unconditionally: rows were written by masked
         # per-lane scatters, and a non-committing lane reverts via tape_len

@@ -63,7 +63,11 @@ def monomorphic() -> bool:
 
             _MONO.append(jax.devices()[0].platform != "cpu")
         except Exception:
-            _MONO.append(False)
+            # do NOT memoize the failure: a transient backend hiccup at
+            # init (tunnel blip) must not pin an accelerator process to
+            # the polymorphic path — and its minutes-long per-bucket
+            # recompiles — forever
+            return False
     return _MONO[0]
 
 # tape_imm is carried FLAT ([L, T*NDIGITS]) so the step kernel keeps one

@@ -56,6 +56,13 @@ def _lib():
         ]
         lib.tsat_ok.argtypes = [ctypes.c_void_p]
         lib.tsat_ok.restype = ctypes.c_int
+        lib.tsat_interrupt.argtypes = [ctypes.c_void_p]
+        lib.tsat_clear_interrupt.argtypes = [ctypes.c_void_p]
+        lib.tsat_set_phase.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
         _configured = True
     return lib
 
@@ -128,6 +135,20 @@ class NativeSat:
         out = array("b", b"\x00")
         out.frombytes(buf)
         return out
+
+    def interrupt(self) -> None:
+        """Cooperatively cancel a solve running in another thread; it
+        returns UNKNOWN at its next poll point (per conflict / per 1024
+        decisions)."""
+        self._lib.tsat_interrupt(self._s)
+
+    def clear_interrupt(self) -> None:
+        self._lib.tsat_clear_interrupt(self._s)
+
+    def set_phase(self, var: int, sign: int) -> None:
+        """Seed the saved decision phase of ``var`` (e.g. from a device
+        model) so the next descent tries that polarity first."""
+        self._lib.tsat_set_phase(self._s, var, sign)
 
     @property
     def ok(self) -> bool:

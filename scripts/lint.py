@@ -7,14 +7,182 @@ standard library can check reliably:
   - every file byte-compiles (SyntaxError = fail)
   - no unused imports (ast-based; `as _name`/`__future__`/re-exports in
     __init__.py and explicitly-noqa'd lines are exempt)
+  - no undefined names (pyflakes-level ast scope walker: a Name load
+    must be bound in some enclosing scope or be a builtin; deliberately
+    order-insensitive so use-before-def never false-positives, and
+    files with star imports are exempt)
   - no tabs in indentation, no trailing whitespace, newline at EOF
 
 Run via scripts/check.sh. Exit 0 = clean.
 """
 
 import ast
+import builtins
 import sys
 from pathlib import Path
+
+_SCOPE_NODES = (
+    ast.Module,
+    ast.FunctionDef,
+    ast.AsyncFunctionDef,
+    ast.Lambda,
+    ast.ClassDef,
+    ast.ListComp,
+    ast.SetComp,
+    ast.DictComp,
+    ast.GeneratorExp,
+)
+
+_BUILTINS = set(dir(builtins)) | {
+    "__file__",
+    "__name__",
+    "__doc__",
+    "__package__",
+    "__spec__",
+    "__loader__",
+    "__builtins__",
+    "__class__",  # zero-arg super() cell in methods
+    "__path__",
+    "__all__",
+}
+
+
+def _scope_bindings(scope: ast.AST):
+    """Names bound directly in ``scope`` (not in nested scopes), plus
+    whether it contains a star import. Any Name in Store/Del context
+    counts — covering assignments, loop targets, with-as, walrus,
+    unpacking — plus args, def/class statements, imports, except/match
+    captures, and global/nonlocal declarations (lenient: treated as
+    local bindings)."""
+    bound = set()
+    star = False
+
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = scope.args
+        for arg in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            + ([a.vararg] if a.vararg else [])
+            + ([a.kwarg] if a.kwarg else [])
+        ):
+            bound.add(arg.arg)
+
+    if isinstance(scope, ast.Module):
+        # conventional module dunders assigned by tooling
+        bound.update(("__version__",))
+
+    stack = list(ast.iter_child_nodes(scope))
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        # defaults/decorators/annotations evaluate in the ENCLOSING
+        # scope; only the body (and its children) binds here. iter_child
+        # already yields body statements for def; Lambda yields body expr.
+        stack = list(scope.body) if isinstance(scope.body, list) else [scope.body]
+    elif isinstance(scope, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+        stack = [g.target for g in scope.generators]
+        # conditions/element run in the comp scope but bind nothing new
+        # beyond walrus targets, which the Store-ctx rule below catches
+        stack += [i for g in scope.generators for i in g.ifs]
+        stack.append(scope.elt if hasattr(scope, "elt") else scope.key)
+        if isinstance(scope, ast.DictComp):
+            stack.append(scope.value)
+
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            bound.add(node.name)
+            # decorators/defaults/annotations/bases evaluate here
+            stack.extend(node.decorator_list)
+            if isinstance(node, ast.ClassDef):
+                stack.extend(node.bases)
+                stack.extend(kw.value for kw in node.keywords)
+            else:
+                a = node.args
+                stack.extend(d for d in a.defaults)
+                stack.extend(d for d in a.kw_defaults if d is not None)
+                anns = [arg.annotation for arg in (
+                    list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])
+                ) if arg.annotation is not None]
+                stack.extend(anns)
+                if node.returns is not None:
+                    stack.append(node.returns)
+            continue  # nested scope's body binds there, not here
+        elif isinstance(node, ast.Lambda):
+            stack.extend(d for d in node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            # first iterable evaluates in THIS scope
+            if node.generators:
+                stack.append(node.generators[0].iter)
+            continue
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                bound.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "*":
+                    star = True
+                else:
+                    bound.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name:
+                bound.add(node.name)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            bound.update(node.names)
+        elif isinstance(node, ast.MatchAs) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.MatchStar) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            bound.add(node.rest)
+        stack.extend(ast.iter_child_nodes(node))
+    return bound, star
+
+
+def undefined_names(tree: ast.AST, source: str):
+    """(lineno, name) pairs for Name loads with no binding in any
+    enclosing scope. Order-insensitive by design: a name bound ANYWHERE
+    in an enclosing scope counts, so late definitions never flag — this
+    catches typos and stale references (NameError-by-construction), not
+    flow bugs."""
+    bindings = {}
+    star_anywhere = False
+    for node in ast.walk(tree):
+        if isinstance(node, _SCOPE_NODES):
+            bound, star = _scope_bindings(node)
+            bindings[id(node)] = bound
+            star_anywhere = star_anywhere or star
+    if star_anywhere:
+        return []  # a star import makes any name potentially defined
+
+    lines = source.splitlines()
+    problems = []
+
+    def visit(node, stack):
+        if isinstance(node, _SCOPE_NODES) and not isinstance(node, ast.Module):
+            stack = stack + [id(node)]
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            name = node.id
+            if name not in _BUILTINS and not any(
+                name in bindings[s] for s in stack
+            ):
+                line = (
+                    lines[node.lineno - 1]
+                    if node.lineno - 1 < len(lines)
+                    else ""
+                )
+                if "noqa" not in line:
+                    problems.append((node.lineno, name))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(tree, [id(tree)])
+    return sorted(set(problems))
 
 REPO = Path(__file__).resolve().parent.parent
 TARGETS = ["mythril_tpu", "tests", "bench.py", "scripts", "__graft_entry__.py"]
@@ -91,6 +259,8 @@ def main() -> int:
             tree, source, path.name == "__init__.py"
         ):
             problems.append(f"{rel}:{lineno}: unused import '{name}'")
+        for lineno, name in undefined_names(tree, source):
+            problems.append(f"{rel}:{lineno}: undefined name '{name}'")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")
             if stripped != stripped.rstrip():

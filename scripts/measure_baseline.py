@@ -15,7 +15,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -67,14 +66,16 @@ def _load(kind: str, path: str):
 
 
 def _run(contracts, tx: int, strategy: str, budget: int):
+    """Benchmark protocol v1 (see support/benchmeter.py): per contract,
+    the measured window runs from the first message-call round to the
+    end of detection/witness solving; creation is excluded. Windows
+    aggregate across a row's contracts."""
     from mythril_tpu.analysis.security import fire_lasers
     from mythril_tpu.analysis.symbolic import SymExecWrapper
-    import mythril_tpu.laser.tpu.backend as backend
+    from mythril_tpu.support.benchmeter import SteadyStateMeter
 
     swcs = set()
-    states = 0
-    solver_queries = 0
-    t0 = time.time()
+    meter = SteadyStateMeter()
     for contract in contracts:
         sym = SymExecWrapper(
             contract,
@@ -83,18 +84,15 @@ def _run(contracts, tx: int, strategy: str, budget: int):
             execution_timeout=budget,
             transaction_count=tx,
             max_depth=128,
+            pre_exec_hook=meter.install,
         )
         for issue in fire_lasers(sym):
             swcs.update(issue.swc_id.split())
-        states += sym.laser.total_states
-        strat = backend.find_tpu_strategy(sym.laser.strategy)
-        if strat is not None:
-            states += strat.device_steps_retired
-    wall = time.time() - t0
+        meter.close()
     return {
-        "wall_s": round(wall, 1),
-        "states": states,
-        "states_per_s": round(states / max(wall, 1e-9), 1),
+        "wall_s": round(meter.wall, 1),
+        "states": meter.states,
+        "states_per_s": round(meter.states_per_s, 1),
         "swcs": sorted(swcs),
     }
 
@@ -130,11 +128,16 @@ def main() -> int:
         found = expected <= set(dev["swcs"])
         results[row] = {
             "platform": platform,
+            "protocol": "steady-state-v1",
             "tx": tx,
             "host": host,
             "tpu_batch": dev,
-            "integrated_vs_host": round(
-                dev["states_per_s"] / max(host["states_per_s"], 1e-9), 2
+            # null, not a sentinel-denominator absurdity, when the host
+            # run starved inside creation (steady window empty)
+            "integrated_vs_host": (
+                round(dev["states_per_s"] / host["states_per_s"], 2)
+                if host["states_per_s"] > 0
+                else None
             ),
             "swc_parity": parity,
             "expected_found": found,
@@ -144,7 +147,7 @@ def main() -> int:
         print(
             f"{row:>20}  host {host['states_per_s']:>8}/s  "
             f"tpu-batch {dev['states_per_s']:>8}/s  "
-            f"x{results[row]['integrated_vs_host']:<6} {status}",
+            f"x{str(results[row]['integrated_vs_host']):<6} {status}",
             file=sys.stderr,
         )
     out = os.path.join(REPO, "BASELINE_MEASURED.json")

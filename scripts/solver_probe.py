@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Witness-solver A/B probe (the PERF_NOTES repro): token.asm -t 2 under
+bfs vs tpu-batch with NativeSat.solve instrumented. Prints per-mode wall,
+call count, total/max solve time — the numbers behind VERDICT r4's two
+losing BASELINE rows.
+
+Usage: python3 scripts/solver_probe.py [bfs|tpu-batch|both] [budget_s]
+"""
+import faulthandler
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+# force CPU: this is the fast solver A/B harness, and the ambient env
+# ships JAX_PLATFORMS=axon — a dead tunnel sleep-retries forever inside
+# backend init (setdefault is NOT enough). The env var alone is ALSO not
+# enough: sitecustomize registered the axon PJRT plugin at interpreter
+# start and jax dials the tunnel during backend init even with cpu
+# selected — deregister the factory, same as tests/conftest.py.
+os.environ["JAX_PLATFORMS"] = "cpu"
+try:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(_xb._backend_factories):
+        if _name not in ("cpu",):
+            _xb._backend_factories.pop(_name, None)
+    jax.config.update("jax_platforms", "cpu")
+except Exception as _e:  # pragma: no cover
+    print(f"warning: could not deregister axon backend ({_e!r})", file=sys.stderr)
+faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
+
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.smt.solver.native import NativeSat
+
+
+class SolveStats:
+    def __init__(self):
+        self.calls = 0
+        self.total = 0.0
+        self.slowest = []  # (dt, n_assumptions)
+
+    def reset(self):
+        self.__init__()
+
+
+STATS = SolveStats()
+_orig_solve = NativeSat.solve
+
+
+def _timed_solve(self, assumptions=None, timeout_ms=None, conflict_budget=None):
+    t0 = time.perf_counter()
+    code = _orig_solve(
+        self, assumptions=assumptions, timeout_ms=timeout_ms,
+        conflict_budget=conflict_budget,
+    )
+    dt = time.perf_counter() - t0
+    STATS.calls += 1
+    STATS.total += dt
+    STATS.slowest.append((dt, len(assumptions or [])))
+    STATS.slowest.sort(reverse=True)
+    del STATS.slowest[5:]
+    return code
+
+
+NativeSat.solve = _timed_solve
+
+
+def run(mode: str, budget: int):
+    STATS.reset()
+    runtime = assemble(open(os.path.join(REPO, "bench_contracts/token.asm")).read()).hex()
+    n = len(runtime) // 2
+    creation = (
+        assemble(
+            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+            "PUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + runtime
+    )
+    contract = EVMContract(code=runtime, creation_code=creation, name="token")
+    t0 = time.time()
+    sym = SymExecWrapper(
+        contract,
+        address=0x1234,
+        strategy=mode,
+        execution_timeout=budget,
+        transaction_count=2,
+        max_depth=128,
+    )
+    issues = fire_lasers(sym)
+    wall = time.time() - t0
+    print(
+        json.dumps(
+            {
+                "mode": mode,
+                "wall_s": round(wall, 2),
+                "solve_calls": STATS.calls,
+                "solve_total_s": round(STATS.total, 2),
+                "slowest": [
+                    (round(dt, 3), n_asm) for dt, n_asm in STATS.slowest
+                ],
+                "swcs": sorted({i.swc_id for i in issues}),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    budget = int(sys.argv[2]) if len(sys.argv) > 2 else 60
+    for mode in (["bfs", "tpu-batch"] if which == "both" else [which]):
+        run(mode, budget)

@@ -32,7 +32,7 @@ cat "$OUT/BENCH_TPU.json"
 # Phase 2: full BASELINE table on chip (all rows incl. the two that lose
 # to host on CPU).
 mark "phase 2: measure_baseline on TPU"
-timeout 4800 python3 scripts/measure_baseline.py --budget 90 >"$OUT/baseline_rows.jsonl"
+timeout 4800 python3 scripts/measure_baseline.py --budget 120 >"$OUT/baseline_rows.jsonl"
 mark "phase 2 rc=$?"
 [ -f BASELINE_MEASURED.json ] && cp BASELINE_MEASURED.json "$OUT/BASELINE_TPU.json"
 

@@ -1,0 +1,88 @@
+"""Benchmark protocol v1: the SteadyStateMeter must exclude contract
+creation from the measured window and aggregate across runs (VERDICT r4
+weak #2 — the creation-amortized quotients made the same config report
+4.9x and 28.4x; reference counter being windowed:
+mythril/laser/ethereum/svm.py:81 total_states)."""
+
+import logging
+
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.support.benchmeter import SteadyStateMeter, _device_steps
+
+logging.getLogger().setLevel(logging.ERROR)
+
+# origin-gated stop: cheap to execute, nonzero message-call state count
+RUNTIME_SRC = "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x02\nADD\nPOP\nSTOP\n"
+
+
+def _contract() -> EVMContract:
+    runtime = assemble(RUNTIME_SRC).hex()
+    n = len(runtime) // 2
+    creation = (
+        assemble(
+            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+            "PUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + runtime
+    )
+    return EVMContract(code=runtime, creation_code=creation, name="Meter")
+
+
+def _analyze(meter: SteadyStateMeter):
+    sym = SymExecWrapper(
+        _contract(),
+        address=0x1234,
+        strategy="bfs",
+        execution_timeout=30,
+        transaction_count=1,
+        max_depth=32,
+        pre_exec_hook=meter.install,
+    )
+    fire_lasers(sym)
+    meter.close()
+    return sym
+
+
+def test_window_excludes_creation():
+    meter = SteadyStateMeter()
+    sym = _analyze(meter)
+    assert len(meter.windows) == 1
+    # creation executed instructions before the window opened, so the
+    # windowed count must be strictly below the engine's total
+    assert 0 < meter.states < sym.laser.total_states
+    assert meter.wall > 0
+    assert meter.states_per_s > 0
+
+
+def test_windows_aggregate_across_runs():
+    meter = SteadyStateMeter()
+    _analyze(meter)
+    one_run_states = meter.states
+    _analyze(meter)
+    assert len(meter.windows) == 2
+    assert meter.states > one_run_states
+    assert meter.wall >= meter.windows[0][1]
+
+
+def test_close_is_idempotent_and_unopened_window_drops():
+    meter = SteadyStateMeter()
+    meter.close()  # nothing installed: no-op
+    assert meter.windows == []
+    _analyze(meter)
+    n = len(meter.windows)
+    meter.close()  # second close after a closed run: no new window
+    assert len(meter.windows) == n
+
+
+def test_device_steps_probe_plain_strategy():
+    class Chain:
+        super_strategy = None
+
+    class Laser:
+        strategy = Chain()
+        total_states = 0
+
+    assert _device_steps(Laser()) == 0
