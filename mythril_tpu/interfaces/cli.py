@@ -89,6 +89,8 @@ def add_analysis_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("-m", "--modules", metavar="MODULES", help="comma-separated detection module whitelist")
     group.add_argument("--no-onchain-data", action="store_true", help="never load code/storage over RPC")
     group.add_argument("-g", "--graph", metavar="HTML_FILE", help="write an interactive CFG graph")
+    group.add_argument("--phrack", action="store_true", help="Phrack-style call graph")
+    group.add_argument("--enable-physics", action="store_true", help="enable graph physics simulation")
     group.add_argument("-j", "--statespace-json", metavar="JSON_FILE", help="dump the explored statespace")
     group.add_argument("--enable-iprof", action="store_true", help="per-opcode instruction profiler")
     group.add_argument("--disable-dependency-pruning", action="store_true")
@@ -185,7 +187,11 @@ def _make_analyzer(source, args, address=None, use_onchain_data=False):
 def _run_analysis(analyzer, args) -> None:
     """Shared analysis tail: -g/-j exports or the full detection run."""
     if args.graph:
-        html = analyzer.graph_html(transaction_count=args.transaction_count)
+        html = analyzer.graph_html(
+            transaction_count=args.transaction_count,
+            enable_physics=args.enable_physics,
+            phrackify=args.phrack,
+        )
         with open(args.graph, "w") as f:
             f.write(html)
         return

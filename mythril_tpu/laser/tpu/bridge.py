@@ -353,7 +353,11 @@ class DeviceBridge:
                 np_batch, lane, symtape.OP_CDSIZE
             )
             # pre-register word reads at 32-byte offsets so round-tripped
-            # stack values lower back to CDLOAD leaves
+            # stack values lower back to CDLOAD leaves. (Measured r5:
+            # this does NOT inflate the Ackermann select tables — the
+            # 68-vs-36 entry growth under tpu-batch comes from
+            # speculative device paths' constraints passing through the
+            # eliminator, not from these leaf registrations.)
             for k in range(self.cfg.calldata_bytes // 32):
                 t = calldata.get_word_at(k * 32)
                 if isinstance(t, BitVec) and t.symbolic:

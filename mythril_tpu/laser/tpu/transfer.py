@@ -19,6 +19,8 @@ TPU/CPU XLA), which the round-trip test pins down.
 
 from functools import partial
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -56,7 +58,14 @@ def monomorphic() -> bool:
     costs MINUTES while the bytes a smaller variant saves ride a link
     whose per-transfer latency dwarfs them. CPU keeps the polymorphic
     path: compiles are cheap there and the suite exercises it.
+
+    ``MYTHRIL_TPU_MONO_TRANSFER=1|0`` overrides the platform choice —
+    benchmark harnesses pin 1 so the measured window isn't salted with
+    per-bucket variant compiles the warmup cannot enumerate.
     """
+    override = os.environ.get("MYTHRIL_TPU_MONO_TRANSFER")
+    if override is not None:
+        return override == "1"
     if not _MONO:
         try:
             import jax

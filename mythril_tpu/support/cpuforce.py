@@ -1,0 +1,35 @@
+"""Pin the current process to the CPU jax backend, tunnel-safely.
+
+Setting ``JAX_PLATFORMS=cpu`` is NOT sufficient on images whose
+sitecustomize registers an accelerator PJRT plugin at interpreter
+start: backend init still dials every registered plugin, and a dead
+single-tenant tunnel either blocks for minutes (tcp recv) or raises.
+The reliable sequence — mirrored from tests/conftest.py — is to drop
+the non-CPU backend factories before first jax use AND latch the
+platform config (the env var alone is too late once sitecustomize has
+imported jax).
+
+Call :func:`force_cpu` at the top of any harness/script that must
+never touch the accelerator.
+"""
+
+import os
+
+
+def force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        for name in list(_xb._backend_factories):
+            if name not in ("cpu",):
+                _xb._backend_factories.pop(name, None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # pragma: no cover - depends on jax internals
+        import warnings
+
+        warnings.warn(
+            f"force_cpu could not deregister non-CPU jax backends ({e!r}); "
+            "this process may dial the accelerator tunnel"
+        )

@@ -104,6 +104,9 @@ def main() -> int:
     args = parser.parse_args()
 
     sys.path.insert(0, REPO)
+    # one transport variant per direction so warmup covers every
+    # compile the measured windows would otherwise absorb (see bench.py)
+    os.environ.setdefault("MYTHRIL_TPU_MONO_TRANSFER", "1")
     import bench
 
     bench._probe_backend()
@@ -151,9 +154,18 @@ def main() -> int:
             file=sys.stderr,
         )
     out = os.path.join(REPO, "BASELINE_MEASURED.json")
+    # merge: a --rows subset run must not clobber the other rows'
+    # baselines (downstream docs cite the whole table)
+    merged = {}
+    try:
+        with open(out) as fh:
+            merged = json.load(fh)
+    except (OSError, ValueError):
+        pass
+    merged.update(results)
     with open(out, "w") as fh:
-        json.dump(results, fh, indent=1)
-    print(f"wrote {out}", file=sys.stderr)
+        json.dump(merged, fh, indent=1)
+    print(f"wrote {out} ({len(results)} row(s) updated)", file=sys.stderr)
     return 0
 
 

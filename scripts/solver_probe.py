@@ -14,23 +14,12 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-# force CPU: this is the fast solver A/B harness, and the ambient env
-# ships JAX_PLATFORMS=axon — a dead tunnel sleep-retries forever inside
-# backend init (setdefault is NOT enough). The env var alone is ALSO not
-# enough: sitecustomize registered the axon PJRT plugin at interpreter
-# start and jax dials the tunnel during backend init even with cpu
-# selected — deregister the factory, same as tests/conftest.py.
-os.environ["JAX_PLATFORMS"] = "cpu"
-try:
-    import jax
-    from jax._src import xla_bridge as _xb
+# force CPU: this is the fast solver A/B harness and must never touch
+# the single-tenant accelerator tunnel (a dead one blocks/raises inside
+# backend init even with JAX_PLATFORMS=cpu in the env)
+from mythril_tpu.support.cpuforce import force_cpu
 
-    for _name in list(_xb._backend_factories):
-        if _name not in ("cpu",):
-            _xb._backend_factories.pop(_name, None)
-    jax.config.update("jax_platforms", "cpu")
-except Exception as _e:  # pragma: no cover
-    print(f"warning: could not deregister axon backend ({_e!r})", file=sys.stderr)
+force_cpu()
 faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
 
 from mythril_tpu.analysis.security import fire_lasers

@@ -11,28 +11,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # Setting the env var is NOT sufficient: /root/.axon_site/sitecustomize.py
 # already registered the axon PJRT plugin at interpreter start, and jax
 # still dials the tunnel during backend init even when only cpu is
-# selected (observed: jax.devices() blocks minutes in tcp recv). Pull the
-# plugin out of the factory registry before the first jax use so tests
-# never touch the tunnel.
-try:
-    import jax
-    from jax._src import xla_bridge as _xb
+# selected (observed: jax.devices() blocks minutes in tcp recv).
+# force_cpu() pulls the plugin out of the factory registry before the
+# first jax use so tests never touch the tunnel (it warns with the
+# exception repr if the private registry API ever moves).
+from mythril_tpu.support.cpuforce import force_cpu  # noqa: E402
 
-    for _name in list(_xb._backend_factories):
-        if _name not in ("cpu",):
-            _xb._backend_factories.pop(_name, None)
-    # sitecustomize imported jax with JAX_PLATFORMS=axon already latched
-    # into the config holder; the env assignment above came too late.
-    jax.config.update("jax_platforms", "cpu")
-except Exception as _e:  # pragma: no cover - depends on jax internals
-    # If the private registry moved in a jax upgrade, tests WILL dial the
-    # TPU tunnel and may block for minutes — make the cause visible.
-    import warnings
-
-    warnings.warn(
-        f"conftest could not deregister non-CPU jax backends ({_e!r}); "
-        "tests may hang on the single-tenant TPU tunnel"
-    )
+force_cpu()
 # Persistent compile cache: the step kernel takes ~1 min to compile on CPU;
 # cache hits make repeated test runs fast.
 os.environ.setdefault(

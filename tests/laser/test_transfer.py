@@ -93,3 +93,15 @@ def test_roundtrip_monomorphic():
         roundtrip(cfg, random_batch(cfg, zero_groups=("symbolic",)))
     finally:
         transfer._MONO.clear()
+
+
+def test_monomorphic_env_override(monkeypatch):
+    # bench harnesses pin one variant per direction via env regardless
+    # of backend; 0 forces the polymorphic path likewise
+    transfer._MONO.clear()
+    monkeypatch.setenv("MYTHRIL_TPU_MONO_TRANSFER", "1")
+    assert transfer.monomorphic() is True
+    monkeypatch.setenv("MYTHRIL_TPU_MONO_TRANSFER", "0")
+    assert transfer.monomorphic() is False
+    monkeypatch.delenv("MYTHRIL_TPU_MONO_TRANSFER")
+    transfer._MONO.clear()

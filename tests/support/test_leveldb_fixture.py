@@ -1,0 +1,83 @@
+"""Cross-implementation LevelDB validation (VERDICT r4 #10).
+
+No geth or plyvel exists in this image, so true cross-implementation
+bytes are unavailable — instead this pins the format from the OTHER
+side: (a) the crc32c primitive is checked against published external
+vectors (RFC 3720 B.4 / the Intel SSE4.2 test set), so it cannot be
+"consistent but wrong"; (b) a write-ahead-log record HAND-ASSEMBLED
+field by field from the public format documents (leveldb
+doc/log_format.md, write_batch encoding in write_batch.cc) — not
+produced by PyLevelDBWriter — is committed below as a hex literal and
+must read back through PyLevelDB.
+"""
+
+from mythril_tpu.ethereum.interface.leveldb.pyleveldb import (
+    PyLevelDB,
+    crc32c,
+)
+
+# Published crc32c (Castagnoli, poly 0x1EDC6F41 reflected 0x82F63B78)
+# test vectors: RFC 3720 appendix B.4 and the canonical Intel set.
+CRC32C_VECTORS = [
+    (b"123456789", 0xE3069283),
+    (bytes(32), 0x8A9136AA),          # 32 x 0x00
+    (b"\xff" * 32, 0x62A8AB43),       # 32 x 0xFF
+    (bytes(range(32)), 0x46DD794E),   # 0x00..0x1F ascending
+]
+
+
+def test_crc32c_published_vectors():
+    for data, want in CRC32C_VECTORS:
+        assert crc32c(data) == want, data
+
+
+# One FULL log record, assembled by hand from the public spec:
+#
+#   log_format.md record = checksum(4 LE) | length(2 LE) | type(1) | data
+#     checksum = masked crc32c over (type byte || data)
+#              = rot15(crc) + 0xA282EAD8  -> 0xD737C574 here
+#     length   = 0x002F (47 payload bytes)
+#     type     = 0x01 (kFullType)
+#   data = WriteBatch: seq(8 LE)=1 | count(4 LE)=3 | ops:
+#     0x01 kTypeValue    varint klen=7  "eth-key"   varint vlen=9 "eth-value"
+#     0x01 kTypeValue    varint klen=2  00 01       varint vlen=1 ff
+#     0x00 kTypeDeletion varint klen=8  "eth-key2"
+HANDCRAFTED_LOG_HEX = (
+    "74c537d7"          # masked crc32c of type+payload (LE)
+    "2f00"              # payload length 47 (LE)
+    "01"                # kFullType
+    "0100000000000000"  # sequence 1
+    "03000000"          # count 3
+    "01" "07" "6574682d6b6579" "09" "6574682d76616c7565"
+    "01" "02" "0001" "01" "ff"
+    "00" "08" "6574682d6b657932"
+)
+
+
+def test_handcrafted_log_reads_back(tmp_path):
+    db_dir = tmp_path / "db"
+    db_dir.mkdir()
+    (db_dir / "CURRENT").write_bytes(b"MANIFEST-000001\n")
+    (db_dir / "MANIFEST-000001").write_bytes(b"")  # reader replays logs only
+    (db_dir / "000003.log").write_bytes(bytes.fromhex(HANDCRAFTED_LOG_HEX))
+
+    db = PyLevelDB(str(db_dir))
+    assert db.get(b"eth-key") == b"eth-value"
+    assert db.get(b"\x00\x01") == b"\xff"
+    assert db.get(b"eth-key2") is None  # deletion tombstone
+    assert sorted(k for k, _ in db) == [b"\x00\x01", b"eth-key"]
+
+
+def test_corrupted_checksum_is_rejected(tmp_path):
+    import pytest
+
+    raw = bytearray(bytes.fromhex(HANDCRAFTED_LOG_HEX))
+    raw[0] ^= 0x01  # flip a checksum bit
+    db_dir = tmp_path / "db"
+    db_dir.mkdir()
+    (db_dir / "CURRENT").write_bytes(b"MANIFEST-000001\n")
+    (db_dir / "000003.log").write_bytes(bytes(raw))
+    # the damaged record must be refused loudly (paranoid-checks
+    # semantics), never half-applied
+    with pytest.raises(ValueError, match="crc mismatch"):
+        PyLevelDB(str(db_dir))
