@@ -351,11 +351,6 @@ def main() -> int:
     from mythril_tpu.laser.tpu import ensure_compile_cache
 
     ensure_compile_cache()
-    # one transfer variant per direction on every backend: warmup then
-    # covers ALL the transport compiles, so no measured window absorbs a
-    # first-use per-bucket variant compile (protocol v1 measures
-    # throughput, not XLA latency)
-    os.environ.setdefault("MYTHRIL_TPU_MONO_TRANSFER", "1")
     _phase("probing backend")
     _probe_backend()
 

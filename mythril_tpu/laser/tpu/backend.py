@@ -80,6 +80,11 @@ DEFAULT_BATCH_CFG = BatchConfig(
     tape_slots=192,
     path_slots=32,
     mem_sym_slots=8,
+    # adaptive engagement: frontiers narrower than this analyze faster
+    # on the host path than through pack/round/lift (tiny contracts
+    # complete in well under a second there); wide exploration switches
+    # to device rounds automatically
+    min_device_frontier=8,
 )
 
 
@@ -324,8 +329,12 @@ def value_replayers_for(laser) -> dict:
 
 
 # frontiers below this size are cheaper on the warm host CDCL than through
-# a device dispatch; above it, one batched call decides every path condition
-MIN_DEVICE_SOLVE_BATCH = 4
+# a device dispatch; above it, one batched call decides every path
+# condition. Aligned with DEFAULT_BATCH_CFG.min_device_frontier: in the
+# narrow regime the hybrid must not pay ANY device dispatch (r5: the
+# suicide+origin row lost 0.2s of a 0.5s window to feasibility batches
+# whose rounds never engaged)
+MIN_DEVICE_SOLVE_BATCH = 8
 
 # device-phase step budget per exec_batch round
 DEVICE_STEP_BUDGET = 4096
@@ -778,8 +787,14 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         # ---------------- phase B: batched device rounds.
         # Until the background warmup lands the compiled kernels, phase A
         # keeps making host progress — none of it wasted — and the device
-        # joins mid-analysis the moment it is ready.
+        # joins mid-analysis the moment it is ready. Narrow frontiers
+        # also stay host-side (min_device_frontier): packing a handful
+        # of states through a device round costs more than executing
+        # them directly, so the device engages when exploration widens.
         if not device_ready(cfg, want_stats):
+            laser.work_list.extend(survivors)
+            continue
+        if len(survivors) < cfg.min_device_frontier:
             laser.work_list.extend(survivors)
             continue
         to_pack = survivors[:seed_cap]

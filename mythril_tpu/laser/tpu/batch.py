@@ -63,6 +63,14 @@ class BatchConfig(NamedTuple):
     # so tape_slots should stay comfortably above the distinct-operand
     # count a full ring can record.
     ss_ring: int = 128
+    # hybrid scheduler policy: the device only joins when the host-phase
+    # survivor frontier reaches this width. Batching a 2-4 state
+    # frontier through pack -> device round -> lift costs more than the
+    # host executing it directly (measured r5: sub-second host analyses
+    # spent 2-3s in hybrid fixed overheads), so narrow frontiers stay on
+    # the host path and the device engages the moment exploration
+    # widens. 0 = always engage (test configs pin this for determinism).
+    min_device_frontier: int = 0
 
 
 class CodeBank(NamedTuple):

@@ -59,12 +59,15 @@ def monomorphic() -> bool:
     whose per-transfer latency dwarfs them. CPU keeps the polymorphic
     path: compiles are cheap there and the suite exercises it.
 
-    ``MYTHRIL_TPU_MONO_TRANSFER=1|0`` overrides the platform choice —
-    benchmark harnesses pin 1 so the measured window isn't salted with
-    per-bucket variant compiles the warmup cannot enumerate.
+    ``MYTHRIL_TPU_MONO_TRANSFER=1|0`` overrides the platform choice
+    (debug/experiment hook). Measured r5: pinning 1 on the CPU backend
+    is a large NET LOSS on round-heavy workloads (suicide+origin row
+    0.5x -> 0.06x host) — full-size plane copies per round dwarf the
+    one-time per-bucket variant compiles the polymorphic path pays.
+    The platform default stands.
     """
     override = os.environ.get("MYTHRIL_TPU_MONO_TRANSFER")
-    if override is not None:
+    if override in ("0", "1"):  # anything else (empty, typo) = unset
         return override == "1"
     if not _MONO:
         try:

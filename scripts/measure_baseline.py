@@ -45,6 +45,26 @@ ROWS = {
 }
 
 
+def _git_rev() -> str:
+    """Provenance stamp: merged rows from different code states must be
+    tellable apart in BASELINE_MEASURED.json."""
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+                capture_output=True,
+                timeout=10,
+            )
+            .stdout.decode()
+            .strip()
+            or "unknown"
+        )
+    except Exception:
+        return "unknown"
+
+
 def _load(kind: str, path: str):
     from mythril_tpu.disassembler.asm import assemble
     from mythril_tpu.ethereum.evmcontract import EVMContract
@@ -104,9 +124,11 @@ def main() -> int:
     args = parser.parse_args()
 
     sys.path.insert(0, REPO)
-    # one transport variant per direction so warmup covers every
-    # compile the measured windows would otherwise absorb (see bench.py)
-    os.environ.setdefault("MYTHRIL_TPU_MONO_TRANSFER", "1")
+    # persistent compile cache BEFORE backend init: repeat invocations
+    # must not pay the kernel compiles inside measured windows
+    from mythril_tpu.laser.tpu import ensure_compile_cache
+
+    ensure_compile_cache()
     import bench
 
     bench._probe_backend()
@@ -132,6 +154,7 @@ def main() -> int:
         results[row] = {
             "platform": platform,
             "protocol": "steady-state-v1",
+            "rev": _git_rev(),
             "tx": tx,
             "host": host,
             "tpu_batch": dev,

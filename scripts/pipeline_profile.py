@@ -30,7 +30,13 @@ use_cprofile = "--cprofile" in sys.argv
 import bench
 from mythril_tpu.disassembler.asm import assemble
 
-runtime = assemble(bench.STRESS_SRC)
+if "--bectoken" in sys.argv:
+    src = open(os.path.join(REPO, "bench_contracts/bectoken.asm")).read()
+    TX = 3
+else:
+    src = bench.STRESS_SRC
+    TX = 2
+runtime = assemble(src)
 n = len(runtime)
 creation_hex = (
     assemble(
@@ -102,7 +108,7 @@ LaserEVM.execute_state = timed_exec_state
 
 def run():
     meter, swcs = bench._steady_analysis(
-        creation_hex, runtime.hex(), "tpu-batch", 2, budget, "BECStress"
+        creation_hex, runtime.hex(), "tpu-batch", TX, budget, "Profiled"
     )
     return meter, swcs
 

@@ -20,6 +20,9 @@ sys.path.insert(0, REPO)
 from mythril_tpu.support.cpuforce import force_cpu
 
 force_cpu()
+from mythril_tpu.laser.tpu import ensure_compile_cache
+
+ensure_compile_cache()
 faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
 
 from mythril_tpu.analysis.security import fire_lasers

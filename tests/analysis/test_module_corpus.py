@@ -96,7 +96,18 @@ def test_corpus_host(name):
         else []
     ),
 )
-def test_corpus_device_parity(name):
+def test_corpus_device_parity(name, monkeypatch):
+    # parity must compare a run where the device REALLY participates:
+    # pin min_device_frontier=0 so the adaptive scheduler cannot keep
+    # these narrow corpus workloads host-side (which would reduce this
+    # to a vacuous host-vs-host comparison)
+    import mythril_tpu.laser.tpu.backend as backend
+
+    monkeypatch.setattr(
+        backend,
+        "DEFAULT_BATCH_CFG",
+        backend.DEFAULT_BATCH_CFG._replace(min_device_frontier=0),
+    )
     host = analyze(name)
     device = analyze(name, strategy="tpu-batch", timeout=400)
     assert host == device, f"{name}: host {host} != device {device}"

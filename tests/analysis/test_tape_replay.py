@@ -7,11 +7,18 @@ exact (VERDICT r2: "make detection modules batch-aware").
 """
 
 
+import pytest
+
 import mythril_tpu.laser.tpu.backend as backend
 from mythril_tpu.analysis.security import fire_lasers
 from mythril_tpu.analysis.symbolic import SymExecWrapper
 from mythril_tpu.disassembler.asm import assemble
 from mythril_tpu.ethereum.evmcontract import EVMContract
+
+# every test here asserts device-retirement mechanics on deliberately
+# tiny workloads: the adaptive narrow-frontier scheduler must not keep
+# them host-side (small_batch pins min_device_frontier=0)
+pytestmark = pytest.mark.usefixtures("small_batch")
 
 
 def analyze(runtime_src: str, modules, strategy="tpu-batch", tx=1):
@@ -241,10 +248,20 @@ _BIG_WRITE_LOOP_SRC = (
 )
 
 
-def test_200_sstore_contract_stays_device_resident():
+def test_200_sstore_contract_stays_device_resident(monkeypatch):
     # the VERDICT r4 #7 acceptance workload: 200+ SSTOREs with storage
     # hooks registered stays device-resident past the ring capacity via
-    # mid-round drain — no trap, one device pass, detection exact
+    # mid-round drain — no trap, one device pass, detection exact.
+    # Needs code_len above the ~1KB body (the shared small cfg's 512
+    # would PackError the contract back to the host path entirely).
+    from mythril_tpu.laser.tpu.batch import BatchConfig
+
+    big_code = BatchConfig(
+        lanes=16, stack_slots=16, memory_bytes=256, calldata_bytes=128,
+        storage_slots=8, code_len=2048, tape_slots=64, path_slots=16,
+        mem_sym_slots=8, ss_ring=128,
+    )
+    monkeypatch.setattr(backend, "DEFAULT_BATCH_CFG", big_code)
     issues, _sym, strategy = analyze(
         _BIG_WRITE_LOOP_SRC, ["IntegerArithmetics"]
     )

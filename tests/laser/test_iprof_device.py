@@ -2,10 +2,25 @@
 must show up in the profiler (VERDICT r2 weak #5 — the measurement
 tools were blind to device execution)."""
 
+import pytest
+
+import mythril_tpu.laser.tpu.backend as backend
 from mythril_tpu.analysis.symbolic import SymExecWrapper
 from mythril_tpu.disassembler.asm import assemble
 from mythril_tpu.ethereum.evmcontract import EVMContract
 from mythril_tpu.laser.evm.iprof import InstructionProfiler
+
+
+@pytest.fixture(autouse=True)
+def always_engage(monkeypatch):
+    # this test asserts device participation on a deliberately tiny
+    # workload; disable the adaptive narrow-frontier scheduler so the
+    # device rounds it profiles actually run
+    monkeypatch.setattr(
+        backend,
+        "DEFAULT_BATCH_CFG",
+        backend.DEFAULT_BATCH_CFG._replace(min_device_frontier=0),
+    )
 
 
 def test_device_rounds_feed_iprof():
