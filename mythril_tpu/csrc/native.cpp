@@ -150,6 +150,15 @@ struct Solver {
   // decisions (the old every-64-conflicts poll made slices unreliable
   // on propagation-heavy phases)
   std::atomic<bool> interrupted{false};
+  // assumption-prefix trail reuse: after a SAT exit the assumption
+  // decisions (levels 1..n) and everything they propagated stay on the
+  // trail; the next solve keeps the longest still-valid shared prefix
+  // instead of re-propagating from scratch. Minimize/CEGAR probe
+  // sequences re-solve with near-identical assumption sets and no new
+  // clauses, so whole prefixes survive. Any clause addition invalidates
+  // the cached trail (trail_dirty).
+  std::vector<Lit> last_assumptions;
+  bool trail_dirty = true;
 
   int lit_index(Lit l) const { return l > 0 ? 2 * l : 2 * (-l) + 1; }
 
@@ -266,6 +275,7 @@ struct Solver {
 
   void add_clause(const Lit* lits, int n) {
     if (!ok) return;
+    trail_dirty = true;
     cancel_until(0);
     std::vector<Lit> c;
     c.reserve(n);
@@ -487,10 +497,34 @@ struct Solver {
     int restart_idx = 0;
     long long restart_limit = 64 * luby(restart_idx);
     long long next_reduce = 4000;
-    cancel_until(0);
+    // keep the longest assumption prefix whose decisions are still on
+    // the trail from the previous (SAT-exited) solve; everything those
+    // levels propagated is reused for free
+    int keep = 0;
+    if (!trail_dirty) {
+      int bound = (int)trail_lim.size();
+      if (n_assumptions < bound) bound = n_assumptions;
+      if ((int)last_assumptions.size() < bound)
+        bound = (int)last_assumptions.size();
+      while (keep < bound && last_assumptions[keep] == assumptions[keep] &&
+             value(assumptions[keep]) == 1)
+        ++keep;
+    }
+    cancel_until(keep);
+    trail_dirty = false;
+    last_assumptions.assign(assumptions, assumptions + n_assumptions);
     if (propagate() != -1) {
-      ok = false;
-      return 20;
+      if (keep == 0) {
+        ok = false;
+        return 20;
+      }
+      // conflict under the reused prefix alone: fall back to a clean
+      // root solve rather than reasoning about which level failed
+      cancel_until(0);
+      if (propagate() != -1) {
+        ok = false;
+        return 20;
+      }
     }
     std::vector<Lit> learnt;
     for (;;) {

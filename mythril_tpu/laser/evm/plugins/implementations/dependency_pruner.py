@@ -20,6 +20,7 @@ from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.evm.plugins.implementations.plugin_annotations import (
     DependencyAnnotation,
     WSDependencyAnnotation,
+    slot_key,
 )
 from mythril_tpu.laser.evm.plugins.plugin import LaserPlugin
 from mythril_tpu.laser.evm.plugins.signals import PluginSkipState
@@ -62,27 +63,37 @@ def world_annotation(state: GlobalState) -> WSDependencyAnnotation:
 
 
 class BlockAccessIndex:
-    """What paths through each basic block (keyed by block address) do."""
+    """What paths through each basic block (keyed by block address) do.
+
+    Slot membership is keyed by STRUCTURAL identity (hash-consed term
+    uid for symbolic slots, the value for concrete ones). The list
+    version's ``slot not in slots`` probed with ``BitVec.__eq__`` —
+    which constructs a symbolic Bool TERM per comparison — and at lift
+    time (every device storage event replays through record_load/store
+    over the whole recorded path) that was 3M+ term constructions and
+    ~1/3 of BECToken's analysis wall."""
 
     def __init__(self):
-        self.loads: Dict[int, List[object]] = {}
-        self.stores: Dict[int, List[object]] = {}
+        # block -> {slot key: slot term}; dict preserves recording order
+        self.loads: Dict[int, Dict[object, object]] = {}
+        self.stores: Dict[int, Dict[object, object]] = {}
         self.calls: Dict[int, bool] = {}
-        self.all_loaded_slots: Set = set()
+        self.all_loaded_slots: Set = set()  # slot KEYS (see slot_key)
 
     @staticmethod
-    def _record(table: Dict[int, List[object]], path: List[int], slot) -> None:
+    def _record(
+        table: Dict[int, Dict[object, object]], path, key, slot
+    ) -> None:
         for block in path:
-            slots = table.setdefault(block, [])
-            if slot not in slots:
-                slots.append(slot)
+            table.setdefault(block, {}).setdefault(key, slot)
 
     def record_load(self, path: List[int], slot) -> None:
-        self._record(self.loads, path, slot)
-        self.all_loaded_slots.add(slot)
+        key = slot_key(slot)  # once per event: this is the replay hot path
+        self._record(self.loads, path, key, slot)
+        self.all_loaded_slots.add(key)
 
     def record_store(self, path: List[int], slot) -> None:
-        self._record(self.stores, path, slot)
+        self._record(self.stores, path, slot_key(slot), slot)
 
     def record_call(self, path: List[int]) -> None:
         for block in path:
@@ -121,7 +132,7 @@ class DependencyPruner(LaserPlugin):
         if block_reads is None:
             return False  # pure block: provably nothing to observe
 
-        if block in self.index.all_loaded_slots:
+        if ("c", block) in self.index.all_loaded_slots:
             # (reference behavior) a block address doubling as an accessed
             # slot defeats the separation; bail to execution when any
             # stored block may alias it
@@ -130,7 +141,9 @@ class DependencyPruner(LaserPlugin):
                     return True
 
         last_writes = annotation.get_storage_write_cache(self.iteration - 1)
-        observable = list(block_reads) + list(annotation.storage_loaded)
+        observable = list(block_reads.values()) + list(
+            annotation.storage_loaded.values()
+        )
         for written_slot in last_writes:
             if any(_may_equal(written_slot, read) for read in observable):
                 return True
@@ -160,8 +173,12 @@ class DependencyPruner(LaserPlugin):
 
         def on_transaction_end(state: GlobalState) -> None:
             annotation = path_annotation(state)
-            for slot in annotation.storage_loaded:
+            for slot in annotation.storage_loaded.values():
                 self.index.record_load(annotation.path, slot)
+            # iterates the OUTER per-iteration dict — i.e. iteration
+            # numbers, not slots — mirroring the reference exactly
+            # (reference dependency_pruner.py:275 does the same; real
+            # written slots are recorded by sstore_hook at fire time)
             for slot in annotation.storage_written:
                 self.index.record_store(annotation.path, slot)
             if annotation.has_call:
@@ -202,8 +219,7 @@ class DependencyPruner(LaserPlugin):
         def sload_hook(state: GlobalState):
             annotation = path_annotation(state)
             slot = state.mstate.stack[-1]
-            if slot not in annotation.storage_loaded:
-                annotation.storage_loaded.append(slot)
+            annotation.storage_loaded.setdefault(slot_key(slot), slot)
             # record against the whole path so far: execution may never
             # reach a clean transaction end
             self.index.record_load(annotation.path, slot)
@@ -230,5 +246,5 @@ class DependencyPruner(LaserPlugin):
             annotation = path_annotation(state)
             # keep the write cache for the next transaction; reset the rest
             annotation.path = [0]
-            annotation.storage_loaded = []
+            annotation.storage_loaded = {}
             world_annotation(state).annotations_stack.append(annotation)

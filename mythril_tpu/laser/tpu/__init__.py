@@ -20,6 +20,22 @@ import os
 import sys
 
 
+def cpu_fingerprint() -> str:
+    """Short stable id of this host's CPU feature set (cache keying)."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as fh:
+            flags = next(
+                (line for line in fh if line.startswith("flags")), ""
+            )
+    except OSError:
+        import platform
+
+        flags = platform.processor() or platform.machine()
+    return hashlib.sha1(flags.encode()).hexdigest()[:12]
+
+
 def ensure_compile_cache() -> None:
     """Point jax at a persistent on-disk compile cache.
 
@@ -32,9 +48,24 @@ def ensure_compile_cache() -> None:
     sitecustomize that imported jax at interpreter start — so CLI
     commands that never touch a device keep their fast startup.
     """
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-        os.path.expanduser("~"), ".cache", "mythril_tpu", "jax"
-    )
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "mythril_tpu", "jax"
+        )
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        if not platforms or platforms.startswith("cpu"):
+            # XLA:CPU AOT cache entries bake the COMPILING host's ISA
+            # features into the executable but the cache key does not;
+            # reusing them on different silicon logs SIGILL warnings and
+            # aborts interpreter teardown (observed r5 after a machine
+            # change between rounds). Key the CPU cache by host
+            # fingerprint — INCLUDING the unset case, where jax may
+            # silently fall back to CPU and would otherwise poison the
+            # shared dir. An explicit accelerator selection (e.g.
+            # JAX_PLATFORMS=axon) keeps the shared dir: jax raises
+            # rather than falling back when a platform is named.
+            cache_dir += "-cpu-" + cpu_fingerprint()
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     # default floor is 1s of compile time; these kernels always clear
     # it, but pin a low floor so smaller helpers cache too

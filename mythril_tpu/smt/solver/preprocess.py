@@ -49,8 +49,20 @@ class TheoryEliminator:
     def _select_congruence(self, entries, idx: Term, var: Term) -> None:
         """Eager pairwise congruence with earlier selects of the array.
         Subclasses may defer this (model-driven lazy congruence) — the
-        quadratic axiom count is fine per query but not process-wide."""
+        quadratic axiom count is fine per query but not process-wide.
+
+        Vacuous pairs are pruned: two selects at DISTINCT CONSTANT
+        indices can never alias, so their axiom is a tautology.
+        (Identical constants hash-cons to the same uid and dedup through
+        ``sel_vars`` before reaching here.) EVM workloads index almost
+        exclusively by constant calldata/storage offsets, so this turns
+        the quadratic axiom sweep into a near-no-op — measured 27.8 s of
+        a 60 s BECToken profile before, dominated by 3.7M bool_eq
+        constructions."""
+        idx_is_const = idx.op == "const"
         for prev_idx, prev_var in entries:
+            if idx_is_const and prev_idx.op == "const":
+                continue  # provably distinct: axiom vacuous
             self.side_conditions.append(
                 terms.bool_or(
                     terms.bool_not(terms.bool_eq(prev_idx, idx)),
@@ -59,8 +71,16 @@ class TheoryEliminator:
             )
 
     def _apply_congruence(self, entries, args, var: Term) -> None:
-        """Eager pairwise congruence with earlier applications of the UF."""
+        """Eager pairwise congruence with earlier applications of the UF.
+        Pairs differing in some constant argument position are provably
+        incongruent — their axiom is vacuous and skipped (same pruning
+        as _select_congruence)."""
         for prev_args, prev_var in entries:
+            if any(
+                pa.op == "const" and a.op == "const" and pa.uid != a.uid
+                for pa, a in zip(prev_args, args)
+            ):
+                continue
             same_args = terms.bool_and(
                 *[terms.bool_eq(pa, a) for pa, a in zip(prev_args, args)]
             )

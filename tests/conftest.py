@@ -19,10 +19,17 @@ from mythril_tpu.support.cpuforce import force_cpu  # noqa: E402
 
 force_cpu()
 # Persistent compile cache: the step kernel takes ~1 min to compile on CPU;
-# cache hits make repeated test runs fast.
+# cache hits make repeated test runs fast. Keyed by host CPU fingerprint:
+# XLA:CPU AOT entries bake the compiling host's ISA features in, and a
+# machine change between rounds made stale entries abort teardown.
+from mythril_tpu.laser.tpu import cpu_fingerprint  # noqa: E402
+
 os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache-" + cpu_fingerprint(),
+    ),
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
