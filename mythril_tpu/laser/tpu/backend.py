@@ -80,11 +80,12 @@ DEFAULT_BATCH_CFG = BatchConfig(
     tape_slots=192,
     path_slots=32,
     mem_sym_slots=8,
-    # adaptive engagement: frontiers narrower than this analyze faster
-    # on the host path than through pack/round/lift (tiny contracts
-    # complete in well under a second there); wide exploration switches
-    # to device rounds automatically
-    min_device_frontier=8,
+    # adaptive engagement (see BatchConfig): any nonempty frontier may
+    # use the device, but only once the analysis has run 1.5 s — tiny
+    # contracts finish on the host before that and never pay a device
+    # round; long-running ones engage and let device forking amplify
+    min_device_frontier=1,
+    device_engage_after_s=1.5,
 )
 
 
@@ -99,6 +100,9 @@ class TpuBatchStrategy(BasicSearchStrategy):
     def __init__(self, work_list, max_depth, batch_cfg: Optional[BatchConfig] = None):
         super().__init__(work_list, max_depth)
         self.batch_cfg = batch_cfg or DEFAULT_BATCH_CFG
+        # monotonic: a wall-clock step (NTP sync on remote VMs) must not
+        # stretch or collapse the device_engage_after_s window
+        self.created_at = time.monotonic()
         self.device_rounds = 0
         self.device_steps_retired = 0
         # storage-ring spill drains performed mid-round (lanes that would
@@ -330,10 +334,11 @@ def value_replayers_for(laser) -> dict:
 
 # frontiers below this size are cheaper on the warm host CDCL than through
 # a device dispatch; above it, one batched call decides every path
-# condition. Aligned with DEFAULT_BATCH_CFG.min_device_frontier: in the
-# narrow regime the hybrid must not pay ANY device dispatch (r5: the
-# suicide+origin row lost 0.2s of a 0.5s window to feasibility batches
-# whose rounds never engaged)
+# condition. Deliberately WIDER than DEFAULT_BATCH_CFG.min_device_frontier
+# (which gates device ROUNDS by width+time): a feasibility dispatch has no
+# fork-amplification upside, so small batches should always stay on the
+# host CDCL — measured r5, the suicide+origin row lost 0.2s of a 0.5s
+# window to sub-8 feasibility batches before this floor
 MIN_DEVICE_SOLVE_BATCH = 8
 
 # device-phase step budget per exec_batch round
@@ -794,7 +799,11 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         if not device_ready(cfg, want_stats):
             laser.work_list.extend(survivors)
             continue
-        if len(survivors) < cfg.min_device_frontier:
+        if len(survivors) < cfg.min_device_frontier or (
+            cfg.device_engage_after_s
+            and time.monotonic() - strategy.created_at
+            < cfg.device_engage_after_s
+        ):
             laser.work_list.extend(survivors)
             continue
         to_pack = survivors[:seed_cap]

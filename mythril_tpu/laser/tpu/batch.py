@@ -63,14 +63,22 @@ class BatchConfig(NamedTuple):
     # so tape_slots should stay comfortably above the distinct-operand
     # count a full ring can record.
     ss_ring: int = 128
-    # hybrid scheduler policy: the device only joins when the host-phase
-    # survivor frontier reaches this width. Batching a 2-4 state
-    # frontier through pack -> device round -> lift costs more than the
-    # host executing it directly (measured r5: sub-second host analyses
-    # spent 2-3s in hybrid fixed overheads), so narrow frontiers stay on
-    # the host path and the device engages the moment exploration
-    # widens. 0 = always engage (test configs pin this for determinism).
+    # hybrid scheduler policy, two gates ANDed together (0 = gate off;
+    # test configs pin both to 0 for deterministic device engagement):
+    #
+    # min_device_frontier: the device only joins when the host-phase
+    # survivor frontier is at least this wide.
+    #
+    # device_engage_after_s: the device only joins once the analysis has
+    # RUN this long. Frontier width alone cannot discriminate (measured
+    # r5: the bench stress workload's host-side frontier never exceeds 2
+    # because the DEVICE's JUMPI forking is what amplifies it — yet
+    # device rounds give it 13x; meanwhile sub-second analyses lose 3x+
+    # to per-round fixed overheads). Elapsed time does discriminate:
+    # contracts the host finishes in under the threshold never pay a
+    # device round, and long-running analyses engage and amplify.
     min_device_frontier: int = 0
+    device_engage_after_s: float = 0.0
 
 
 class CodeBank(NamedTuple):

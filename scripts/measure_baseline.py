@@ -149,6 +149,22 @@ def main() -> int:
             continue
         host = _run(contracts, tx, "bfs", args.budget)
         dev = _run(contracts, tx, "tpu-batch", args.budget)
+        # sub-second windows are scheduler-noise-dominated (identical
+        # code measured 1.26x and 0.81x on the same row); repeat tiny
+        # rows and keep the MEDIAN rate per engine
+        if host["wall_s"] + dev["wall_s"] < 10:
+            hosts = [host] + [_run(contracts, tx, "bfs", args.budget) for _ in range(2)]
+            devs = [dev] + [
+                _run(contracts, tx, "tpu-batch", args.budget) for _ in range(2)
+            ]
+            host = sorted(hosts, key=lambda r: r["states_per_s"])[1]
+            dev = sorted(devs, key=lambda r: r["states_per_s"])[1]
+            # rate is the MEDIAN run's; detection is judged on the UNION
+            # so parity never hinges on which rerun happened to be median
+            host["swcs"] = sorted(set().union(*(r["swcs"] for r in hosts)))
+            dev["swcs"] = sorted(set().union(*(r["swcs"] for r in devs)))
+            host["runs"] = len(hosts)
+            dev["runs"] = len(devs)
         parity = set(host["swcs"]) == set(dev["swcs"])
         found = expected <= set(dev["swcs"])
         results[row] = {

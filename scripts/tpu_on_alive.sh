@@ -8,6 +8,10 @@ set -u
 cd /root/repo
 OUT=/root/repo/.tpu_r5
 mkdir -p "$OUT"
+# single-flight: the tunnel is single-tenant, two campaigns would wedge
+# each other mid-compile
+exec 9>"$OUT/campaign.lock"
+flock -n 9 || { echo "campaign already running; exiting"; exit 0; }
 exec >>"$OUT/campaign.log" 2>&1
 echo "=== campaign start $(date +%F_%T) ==="
 

@@ -106,7 +106,9 @@ def test_corpus_device_parity(name, monkeypatch):
     monkeypatch.setattr(
         backend,
         "DEFAULT_BATCH_CFG",
-        backend.DEFAULT_BATCH_CFG._replace(min_device_frontier=0),
+        backend.DEFAULT_BATCH_CFG._replace(
+            min_device_frontier=0, device_engage_after_s=0.0
+        ),
     )
     host = analyze(name)
     device = analyze(name, strategy="tpu-batch", timeout=400)

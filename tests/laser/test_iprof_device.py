@@ -19,7 +19,9 @@ def always_engage(monkeypatch):
     monkeypatch.setattr(
         backend,
         "DEFAULT_BATCH_CFG",
-        backend.DEFAULT_BATCH_CFG._replace(min_device_frontier=0),
+        backend.DEFAULT_BATCH_CFG._replace(
+            min_device_frontier=0, device_engage_after_s=0.0
+        ),
     )
 
 
