@@ -771,8 +771,18 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 log.debug("Encountered unimplemented instruction")
                 continue
             produced.append((global_state, new_states, op_code))
-        # feasibility for the whole successor frontier in one device call
-        filter_feasible([s for _, states, _ in produced for s in states])
+        # pre-engagement the analysis must behave like the pure host
+        # loop — including NO device feasibility dispatches (measured
+        # r5: they alone cost the suicide+origin row ~25%); the survivor
+        # loop below performs the same per-state is_possible check the
+        # batched call would have seeded
+        engaged = not cfg.device_engage_after_s or (
+            time.monotonic() - strategy.created_at
+            >= cfg.device_engage_after_s
+        )
+        if engaged:
+            # feasibility for the whole successor frontier in one call
+            filter_feasible([s for _, states, _ in produced for s in states])
         survivors = []
         for global_state, new_states, op_code in produced:
             new_states = [
@@ -799,11 +809,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         if not device_ready(cfg, want_stats):
             laser.work_list.extend(survivors)
             continue
-        if len(survivors) < cfg.min_device_frontier or (
-            cfg.device_engage_after_s
-            and time.monotonic() - strategy.created_at
-            < cfg.device_engage_after_s
-        ):
+        if len(survivors) < cfg.min_device_frontier or not engaged:
             laser.work_list.extend(survivors)
             continue
         to_pack = survivors[:seed_cap]
