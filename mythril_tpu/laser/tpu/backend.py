@@ -353,6 +353,16 @@ _warmup_lock = threading.Lock()
 _warmup_events: dict = {}
 _warmup_done = set()
 
+
+def _warmup_key(cfg: BatchConfig, want_stats: bool):
+    """Warmup identity = the SHAPE-bearing fields only: scheduler policy
+    knobs (min_device_frontier, device_engage_after_s) change no kernel,
+    so configs differing only there share one compile."""
+    return (
+        cfg._replace(min_device_frontier=0, device_engage_after_s=0.0),
+        want_stats,
+    )
+
 # The product path compiles on a background thread and lets host rounds
 # overlap (see warmup_device_async). The test suite flips this to False
 # (tests/conftest.py): tests assert device participation deterministically,
@@ -362,13 +372,13 @@ WARMUP_ASYNC = True
 
 def device_ready(cfg: BatchConfig, want_stats: bool = False) -> bool:
     """True once the kernels for this config compiled successfully."""
-    return (cfg, want_stats) in _warmup_done
+    return _warmup_key(cfg, want_stats) in _warmup_done
 
 
 def _warmup_attempted(cfg: BatchConfig, want_stats: bool = False) -> bool:
     """True once a compile attempt for this config has CONCLUDED (either
     way) — distinguishes 'warmup failed' from 'still compiling'."""
-    event = _warmup_events.get((cfg, want_stats))
+    event = _warmup_events.get(_warmup_key(cfg, want_stats))
     return event is not None and event.is_set()
 
 
@@ -406,7 +416,7 @@ def warmup_device_async(cfg: BatchConfig, want_stats: bool = False) -> None:
     if not WARMUP_ASYNC:
         warmup_device(cfg, want_stats)
         return
-    key = (cfg, want_stats)
+    key = _warmup_key(cfg, want_stats)
     event, owner = _claim_warmup(key)
     if owner:
         threading.Thread(
@@ -426,7 +436,7 @@ def warmup_device(cfg: BatchConfig, want_stats: bool = False) -> None:
     warms it on demand when the profiler is enabled). Synchronous: on
     return the config is either ready (device_ready true) or has failed
     for the life of the process."""
-    key = (cfg, want_stats)
+    key = _warmup_key(cfg, want_stats)
     event, owner = _claim_warmup(key)
     if not owner:
         event.wait()

@@ -1,0 +1,45 @@
+"""Adaptive device-engagement policy (round 5).
+
+Width cannot discriminate (fork-amplified workloads keep a 1-2 wide
+host frontier), so the scheduler gates device rounds AND device
+feasibility dispatches on analysis runtime: below
+``device_engage_after_s`` the hybrid must behave exactly like the pure
+host loop; past it, any nonempty frontier may engage."""
+
+import mythril_tpu.laser.tpu.backend as backend
+
+from tests.analysis.conftest import SMALL_BATCH_CFG, analyze_contract
+
+_SRC = (
+    "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x20\nCALLDATALOAD\nADD\n"
+    "PUSH1 0x00\nSSTORE\nSTOP"
+)
+
+
+def _analyze(monkeypatch, engage_after: float):
+    monkeypatch.setattr(
+        backend,
+        "DEFAULT_BATCH_CFG",
+        SMALL_BATCH_CFG._replace(
+            min_device_frontier=1, device_engage_after_s=engage_after
+        ),
+    )
+    issues, _sym, strategy = analyze_contract(
+        _SRC, ["IntegerArithmetics"], timeout=120
+    )
+    return issues, strategy
+
+
+def test_pre_engagement_stays_pure_host(monkeypatch):
+    # a threshold the analysis can never reach: zero device rounds, yet
+    # detection is fully intact through the host path
+    issues, strategy = _analyze(monkeypatch, engage_after=3600.0)
+    assert strategy.device_rounds == 0
+    assert strategy.device_steps_retired == 0
+    assert "101" in {i.swc_id for i in issues}
+
+
+def test_immediate_engagement_reaches_device(monkeypatch):
+    issues, strategy = _analyze(monkeypatch, engage_after=0.0)
+    assert strategy.device_steps_retired > 0
+    assert "101" in {i.swc_id for i in issues}
