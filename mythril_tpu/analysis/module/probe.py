@@ -101,6 +101,21 @@ class ProbeModule(DetectionModule):
         this to point back at the hooked instruction."""
         return state.get_current_instruction()["address"]
 
+    def reset_module(self):
+        super().reset_module()
+        self._screened_sat = set()
+
+    def _screen_key(self, address, finding):
+        """Identity of a deferred finding across sibling paths: site
+        address + the hash-consed uids of its extra constraints. Lanes
+        lifted from a shared tape prefix produce the SAME condition
+        terms, so the key collapses their screens into one."""
+        uids = []
+        for c in finding.constraints:
+            raw = getattr(c, "raw", None)
+            uids.append(raw.uid if raw is not None else id(c))
+        return (address, tuple(uids))
+
     def _execute(self, state: GlobalState) -> None:
         if self.site_address(state) in self.cache:
             return
@@ -128,10 +143,34 @@ class ProbeModule(DetectionModule):
         constraints += finding.constraints
 
         if deferred:
-            try:
-                solver.get_model(constraints)
-            except UnsatError:
-                return False
+            # the collection-time screen only exists to keep provably-dead
+            # findings out of the parked set; once ANY sibling path
+            # screened this exact finding satisfiable, later paths park
+            # directly — the authoritative per-path solve happens at
+            # transaction-end settlement either way
+            # (check_potential_issues). Under tpu-batch, lifted lanes
+            # sharing a tape prefix re-fire the same hazard site per
+            # lane; without this collapse each paid a ~100 ms screen.
+            # first_match_only modules need a PER-PATH verdict here (a
+            # collapsed screen would let a dead finding's park suppress
+            # a satisfiable fallback on this path), so only collect-all
+            # modules share screens across sibling paths
+            if self.first_match_only:
+                try:
+                    solver.get_model(constraints)
+                except UnsatError:
+                    return False
+            else:
+                screened = getattr(self, "_screened_sat", None)
+                if screened is None:
+                    screened = self._screened_sat = set()
+                key = self._screen_key(address, finding)
+                if key not in screened:
+                    try:
+                        solver.get_model(constraints)
+                    except UnsatError:
+                        return False
+                    screened.add(key)
             annotation = get_potential_issues_annotation(state)
             annotation.potential_issues.append(
                 PotentialIssue(detector=self, constraints=constraints, **common)

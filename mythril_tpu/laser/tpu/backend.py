@@ -103,6 +103,12 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # monotonic: a wall-clock step (NTP sync on remote VMs) must not
         # stretch or collapse the device_engage_after_s window
         self.created_at = time.monotonic()
+        # precomputed deadline so engaged() costs one monotonic() call —
+        # svm.exec polls it per instruction in the pre-engagement tier
+        engage_after = self.batch_cfg.device_engage_after_s
+        self._engage_deadline = (
+            self.created_at + engage_after if engage_after else None
+        )
         self.device_rounds = 0
         self.device_steps_retired = 0
         # storage-ring spill drains performed mid-round (lanes that would
@@ -115,6 +121,15 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # whole CLI behind a compile that can take minutes on a slow
         # machine — or forever on a wedged accelerator tunnel.
         warmup_device_async(self.batch_cfg)
+
+    def engaged(self) -> bool:
+        """The scheduler's time gate: ONE definition shared by svm.exec
+        (pre-engagement host tier + mid-phase handoff) and exec_batch
+        (device rounds / feasibility dispatches)."""
+        return (
+            self._engage_deadline is None
+            or time.monotonic() >= self._engage_deadline
+        )
 
     def get_strategic_global_state(self) -> GlobalState:
         return self.work_list.pop(0)
@@ -786,10 +801,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         # r5: they alone cost the suicide+origin row ~25%); the survivor
         # loop below performs the same per-state is_possible check the
         # batched call would have seeded
-        engaged = not cfg.device_engage_after_s or (
-            time.monotonic() - strategy.created_at
-            >= cfg.device_engage_after_s
-        )
+        engaged = strategy.engaged()
         if engaged:
             # feasibility for the whole successor frontier in one call
             filter_feasible([s for _, states, _ in produced for s in states])
