@@ -26,6 +26,7 @@ from typing import Iterable, Optional
 
 from mythril_tpu.analysis import solver
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis import potential_issues
 from mythril_tpu.analysis.potential_issues import (
     PotentialIssue,
     get_potential_issues_annotation,
@@ -144,17 +145,19 @@ class ProbeModule(DetectionModule):
 
         if deferred:
             # the collection-time screen only exists to keep provably-dead
-            # findings out of the parked set; once ANY sibling path
-            # screened this exact finding satisfiable, later paths park
-            # directly — the authoritative per-path solve happens at
-            # transaction-end settlement either way
-            # (check_potential_issues). Under tpu-batch, lifted lanes
-            # sharing a tape prefix re-fire the same hazard site per
-            # lane; without this collapse each paid a ~100 ms screen.
-            # first_match_only modules need a PER-PATH verdict here (a
-            # collapsed screen would let a dead finding's park suppress
-            # a satisfiable fallback on this path), so only collect-all
-            # modules share screens across sibling paths
+            # findings out of the parked set; the authoritative per-path
+            # solve happens at transaction-end settlement either way
+            # (check_potential_issues). Three tiers, cheapest applicable:
+            #   1. first_match_only: eager host solve, always — these
+            #      modules need a PER-PATH verdict here (a collapsed or
+            #      deferred screen could suppress a satisfiable fallback).
+            #   2. LAZY_SCREEN (tpu-batch lift): park unscreened; the
+            #      backend triages the lifted frontier's parks in ONE
+            #      batched device feasibility call afterwards.
+            #   3. sibling-collapse: once ANY path screened this exact
+            #      finding satisfiable, later paths park directly.
+            lazy = False
+            key = None
             if self.first_match_only:
                 try:
                     solver.get_model(constraints)
@@ -165,7 +168,9 @@ class ProbeModule(DetectionModule):
                 if screened is None:
                     screened = self._screened_sat = set()
                 key = self._screen_key(address, finding)
-                if key not in screened:
+                if potential_issues.LAZY_SCREEN:
+                    lazy = key not in screened
+                elif key not in screened:
                     try:
                         solver.get_model(constraints)
                     except UnsatError:
@@ -173,7 +178,13 @@ class ProbeModule(DetectionModule):
                     screened.add(key)
             annotation = get_potential_issues_annotation(state)
             annotation.potential_issues.append(
-                PotentialIssue(detector=self, constraints=constraints, **common)
+                PotentialIssue(
+                    detector=self,
+                    constraints=constraints,
+                    screened=not lazy,
+                    screen_key=(self, key) if key is not None else None,
+                    **common,
+                )
             )
             return True
 

@@ -14,6 +14,17 @@ from mythril_tpu.laser.evm.state.annotation import StateAnnotation
 from mythril_tpu.laser.evm.state.global_state import GlobalState
 
 
+# When True (set by the tpu-batch backend around lane lifting), deferred
+# findings park WITHOUT the collection-time satisfiability screen; the
+# backend then triages every parked-unscreened finding of the lifted
+# frontier in ONE batched device feasibility call (the screens were ~73 ms
+# host solves each, the dominant lift cost on solver-heavy contracts).
+# The reference parks unscreened always (its modules append directly),
+# so skipping the screen is parity-safe; the batch triage just keeps the
+# parked set small the way the eager screen did.
+LAZY_SCREEN = False
+
+
 class PotentialIssuesAnnotation(StateAnnotation):
     def __init__(self):
         self.potential_issues = []
@@ -65,6 +76,8 @@ class PotentialIssue:
         "bytecode",
         "constraints",
         "detector",
+        "screened",
+        "screen_key",
     )
 
     def __init__(
@@ -80,6 +93,8 @@ class PotentialIssue:
         description_head="",
         description_tail="",
         constraints=None,
+        screened=True,
+        screen_key=None,
     ):
         self.title = title
         self.contract = contract
@@ -92,6 +107,12 @@ class PotentialIssue:
         self.bytecode = bytecode
         self.constraints = constraints or []
         self.detector = detector
+        # False while a LAZY_SCREEN park awaits the backend's batched
+        # feasibility triage; settlement treats both values identically.
+        # screen_key identifies the finding ACROSS sibling paths (site
+        # address + finding-constraint uids) for triage grouping.
+        self.screened = screened
+        self.screen_key = screen_key
 
     def promote(self, state: GlobalState, transaction_sequence) -> None:
         """Hand the finished Issue to the detector that parked this."""

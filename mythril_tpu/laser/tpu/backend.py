@@ -693,6 +693,61 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
     return [s for s in states if s.world_state.constraints.is_possible]
 
 
+def _triage_lazy_screens(states: List[GlobalState]) -> None:
+    """Batch-screen the lifted frontier's unscreened parked findings in
+    one device feasibility dispatch.
+
+    Sibling lanes park the SAME finding (identical screen_key) under
+    different path prefixes; one REPRESENTATIVE per group is solved —
+    a provable-UNSAT representative is removed (what the eager host
+    screen did, minus the ~73 ms solve), and a SAT verdict seeds the
+    detector's sibling-collapse set so later host-path parks skip their
+    eager screen too. Siblings are never culled on the representative's
+    verdict (their path prefixes differ; UNSAT does not transfer) —
+    they stay parked for transaction-end settlement, which re-solves
+    authoritatively, so unknown verdicts are always safe to keep."""
+    from mythril_tpu.analysis.potential_issues import PotentialIssuesAnnotation
+
+    groups: dict = {}  # screen_key -> [(annotation, issue), ...]
+    seen = set()
+    for state in states:
+        for ann in state.get_annotations(PotentialIssuesAnnotation):
+            for issue in ann.potential_issues:
+                if not issue.screened and id(issue) not in seen:
+                    seen.add(id(issue))
+                    key = issue.screen_key or ("anon", id(issue))
+                    groups.setdefault(key, []).append((ann, issue))
+    if not groups:
+        return
+    for members in groups.values():
+        for _, issue in members:
+            issue.screened = True
+    # same economics as filter_feasible: tiny batches are not worth a
+    # device dispatch — the parks go to settlement unscreened
+    if len(groups) < MIN_DEVICE_SOLVE_BATCH or not _warmup_done:
+        return
+    reps = [members[0] for members in groups.values()]
+    try:
+        sets = [[c.raw for c in issue.constraints] for _, issue in reps]
+        verdicts = solver_jax.feasibility_batch(sets, flips=384)
+    except Exception as e:  # pragma: no cover - device issues degrade
+        log.warning("lazy screen triage failed: %s", e)
+        return
+    for key, (ann, issue), verdict in zip(groups, reps, verdicts):
+        if verdict is False:
+            try:
+                ann.potential_issues.remove(issue)
+            except ValueError:  # pragma: no cover - shared annotation
+                pass
+        elif verdict is True and isinstance(key, tuple) and len(key) == 2:
+            detector, fkey = key
+            if fkey is not None and hasattr(detector, "_screen_key"):
+                screened = getattr(detector, "_screened_sat", None)
+                if screened is None:
+                    screened = detector._screened_sat = set()
+                screened.add(fkey)
+
+
 def _apply_loop_bound(laser, states: List[GlobalState]) -> List[GlobalState]:
     """Enforce -b on device-explored loops: host-side the bound fires when
     a state is SELECTED at a JUMPDEST, but lanes that looped on device
@@ -916,24 +971,35 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         alive = np.asarray(out.alive)
         status = np.asarray(out.status)
         resumed_states = []
-        for lane in range(cfg.lanes):
-            if not alive[lane]:
-                continue
-            if status[lane] == RUNNING:
-                # step budget exhausted mid-flight: unpack and continue on
-                # whatever path the next iteration chooses
-                pass
-            try:
-                resumed = bridge.unpack_lane(out, lane)
-            except PluginSkipState:
-                # block-entry replay pruned the state (dependency pruner:
-                # re-entering this block cannot observe new writes)
-                log.debug("lane %d pruned at lifted block entry", lane)
-                continue
-            except Exception as e:  # pragma: no cover - lift bugs surface here
-                log.warning("unpack failed for lane %d: %s", lane, e)
-                continue
-            resumed_states.append(resumed)
+        # deferred findings collected during hook replay park UNSCREENED
+        # (potential_issues.LAZY_SCREEN); the whole frontier's screens
+        # then run as one batched device feasibility call below instead
+        # of one ~73 ms host solve per finding per lane
+        from mythril_tpu.analysis import potential_issues as _pi
+
+        _pi.LAZY_SCREEN = True
+        try:
+            for lane in range(cfg.lanes):
+                if not alive[lane]:
+                    continue
+                if status[lane] == RUNNING:
+                    # step budget exhausted mid-flight: unpack and
+                    # continue on whatever path the next iteration picks
+                    pass
+                try:
+                    resumed = bridge.unpack_lane(out, lane)
+                except PluginSkipState:
+                    # block-entry replay pruned the state (dependency
+                    # pruner: re-entering cannot observe new writes)
+                    log.debug("lane %d pruned at lifted block entry", lane)
+                    continue
+                except Exception as e:  # pragma: no cover - lift bugs
+                    log.warning("unpack failed for lane %d: %s", lane, e)
+                    continue
+                resumed_states.append(resumed)
+        finally:
+            _pi.LAZY_SCREEN = False
+        _triage_lazy_screens(resumed_states)
         laser.work_list.extend(
             _apply_loop_bound(laser, filter_feasible(resumed_states))
         )
