@@ -238,6 +238,38 @@ class IntegerArithmetics(DetectionModule):
                 continue
             self._report(state, hazard, witness)
 
+    # -- batched prescreen protocol (tpu-batch backend) ----------------------
+
+    def batch_prescreen_requests(self, state):
+        """(cache token, constraints) pairs the backend may solve in one
+        batched device feasibility call; verdicts come back through
+        seed_prescreen. Covers exactly what _wrap_feasible would solve
+        per hazard at settlement — origin-identity keyed, so a verdict
+        seeded here makes the settlement solve a cache hit."""
+        # non-mutating lookup: this is a read path the backend calls on
+        # every lifted state (including ones this module never touched —
+        # e.g. when excluded via --modules); attaching an empty sink
+        # annotation here would inflate every subsequent fork's copy
+        sink = next(iter(state.get_annotations(HazardsReachedSink)), None)
+        if sink is None:
+            return []
+        requests = []
+        for hazard in sink.hazards:
+            origin = hazard.origin_state
+            if origin in self._origin_sat or origin in self._origin_unsat:
+                continue
+            requests.append(
+                (
+                    origin,
+                    list(origin.world_state.constraints)
+                    + [hazard.condition],
+                )
+            )
+        return requests
+
+    def seed_prescreen(self, token, verdict: bool) -> None:
+        (self._origin_sat if verdict else self._origin_unsat).add(token)
+
     def _wrap_feasible(self, hazard) -> bool:
         """Solve the wrap condition at its origin once per origin state."""
         origin = hazard.origin_state

@@ -693,6 +693,12 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
     return [s for s in states if s.world_state.constraints.is_possible]
 
 
+# consecutive all-unknown triage dispatches before the screen triage
+# stops dispatching for the rest of the process (list for mutability)
+_TRIAGE_MAX_STRIKES = 2
+_TRIAGE_STRIKES = [0]
+
+
 def _triage_lazy_screens(states: List[GlobalState]) -> None:
     """Batch-screen the lifted frontier's unscreened parked findings in
     one device feasibility dispatch.
@@ -717,22 +723,59 @@ def _triage_lazy_screens(states: List[GlobalState]) -> None:
                     seen.add(id(issue))
                     key = issue.screen_key or ("anon", id(issue))
                     groups.setdefault(key, []).append((ann, issue))
-    if not groups:
-        return
     for members in groups.values():
         for _, issue in members:
             issue.screened = True
+
+    # the decisiveness cutoff (and warmup state) gates ALL remaining
+    # work, including the prescreen collection below: once the device
+    # triage has proven indecisive on this workload's query population
+    # (measured: BECToken's deep instances return 100% unknown from
+    # UP+WalkSAT), later rounds must not keep paying the per-hazard
+    # constraint-list copies either
+    if not _warmup_done or _TRIAGE_STRIKES[0] >= _TRIAGE_MAX_STRIKES:
+        return
+
+    # settlement prescreens: modules exposing the protocol (integer's
+    # _wrap_feasible cache) contribute (token, constraints) requests so
+    # their transaction-end solves become cache hits. The loader list is
+    # unfiltered — a module disabled for this run never tagged hazards,
+    # so its collection is a cheap empty-annotation scan per state.
+    prescreen = []  # (detector, token, constraints)
+    pre_seen = set()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for module in ModuleLoader().get_detection_modules():
+        collect = getattr(module, "batch_prescreen_requests", None)
+        if collect is None:
+            continue
+        for state in states:
+            try:
+                requests = collect(state)
+            except Exception:  # pragma: no cover - prescreen best-effort
+                continue
+            for token, constraints in requests:
+                if (id(module), id(token)) in pre_seen:
+                    continue
+                pre_seen.add((id(module), id(token)))
+                prescreen.append((module, token, constraints))
+
     # same economics as filter_feasible: tiny batches are not worth a
     # device dispatch — the parks go to settlement unscreened
-    if len(groups) < MIN_DEVICE_SOLVE_BATCH or not _warmup_done:
+    if len(groups) + len(prescreen) < MIN_DEVICE_SOLVE_BATCH:
         return
     reps = [members[0] for members in groups.values()]
     try:
         sets = [[c.raw for c in issue.constraints] for _, issue in reps]
+        sets += [[c.raw for c in cons] for _, _, cons in prescreen]
         verdicts = solver_jax.feasibility_batch(sets, flips=384)
     except Exception as e:  # pragma: no cover - device issues degrade
         log.warning("lazy screen triage failed: %s", e)
         return
+    if any(v is not None for v in verdicts):
+        _TRIAGE_STRIKES[0] = 0
+    else:
+        _TRIAGE_STRIKES[0] += 1
     for key, (ann, issue), verdict in zip(groups, reps, verdicts):
         if verdict is False:
             try:
@@ -746,6 +789,14 @@ def _triage_lazy_screens(states: List[GlobalState]) -> None:
                 if screened is None:
                     screened = detector._screened_sat = set()
                 screened.add(fkey)
+    for (module, token, _), verdict in zip(
+        prescreen, verdicts[len(reps):]
+    ):
+        if verdict is not None:
+            try:
+                module.seed_prescreen(token, bool(verdict))
+            except Exception:  # pragma: no cover - prescreen best-effort
+                pass
 
 
 def _apply_loop_bound(laser, states: List[GlobalState]) -> List[GlobalState]:
