@@ -240,12 +240,17 @@ class IntegerArithmetics(DetectionModule):
 
     # -- batched prescreen protocol (tpu-batch backend) ----------------------
 
-    def batch_prescreen_requests(self, state):
+    def batch_prescreen_requests(self, state, skip):
         """(cache token, constraints) pairs the backend may solve in one
         batched device feasibility call; verdicts come back through
         seed_prescreen. Covers exactly what _wrap_feasible would solve
         per hazard at settlement — origin-identity keyed, so a verdict
-        seeded here makes the settlement solve a cache hit."""
+        seeded here makes the settlement solve a cache hit.
+
+        ``skip`` (mutated here) dedups BEFORE the constraint lists are
+        materialized: sibling lifted states share origins, and building
+        BECToken-scale constraint copies per duplicate just for the
+        caller to discard was the dominant collection cost."""
         # non-mutating lookup: this is a read path the backend calls on
         # every lifted state (including ones this module never touched —
         # e.g. when excluded via --modules); attaching an empty sink
@@ -256,8 +261,13 @@ class IntegerArithmetics(DetectionModule):
         requests = []
         for hazard in sink.hazards:
             origin = hazard.origin_state
-            if origin in self._origin_sat or origin in self._origin_unsat:
+            if (
+                origin in skip
+                or origin in self._origin_sat
+                or origin in self._origin_unsat
+            ):
                 continue
+            skip.add(origin)
             requests.append(
                 (
                     origin,

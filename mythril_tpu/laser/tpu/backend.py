@@ -109,6 +109,10 @@ class TpuBatchStrategy(BasicSearchStrategy):
         self._engage_deadline = (
             self.created_at + engage_after if engage_after else None
         )
+        # fresh analysis, fresh triage state: an indecisive prior
+        # contract must not disable triage for this one
+        _TRIAGE_STRIKES[0] = 0
+        _TRIAGE_UNKNOWN_TOKENS.clear()
         self.device_rounds = 0
         self.device_steps_retired = 0
         # storage-ring spill drains performed mid-round (lanes that would
@@ -694,9 +698,14 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
 
 
 # consecutive all-unknown triage dispatches before the screen triage
-# stops dispatching for the rest of the process (list for mutability)
+# stops dispatching for the rest of the ANALYSIS (reset by each
+# TpuBatchStrategy construction; list for mutability). Tokens whose
+# prescreen came back unknown are memoized for the analysis so they are
+# neither re-materialized nor re-dispatched (they hold strong refs, but
+# the hazards' annotations keep those origins alive anyway).
 _TRIAGE_MAX_STRIKES = 2
 _TRIAGE_STRIKES = [0]
+_TRIAGE_UNKNOWN_TOKENS: set = set()
 
 
 def _triage_lazy_screens(states: List[GlobalState]) -> None:
@@ -742,22 +751,22 @@ def _triage_lazy_screens(states: List[GlobalState]) -> None:
     # unfiltered — a module disabled for this run never tagged hazards,
     # so its collection is a cheap empty-annotation scan per state.
     prescreen = []  # (detector, token, constraints)
-    pre_seen = set()
     from mythril_tpu.analysis.module.loader import ModuleLoader
 
     for module in ModuleLoader().get_detection_modules():
         collect = getattr(module, "batch_prescreen_requests", None)
         if collect is None:
             continue
+        # skip holds tokens the module must not re-materialize
+        # constraints for: already collected this round, or previously
+        # triaged unknown (beyond the device solver's budget)
+        skip = set(_TRIAGE_UNKNOWN_TOKENS)
         for state in states:
             try:
-                requests = collect(state)
+                requests = collect(state, skip)
             except Exception:  # pragma: no cover - prescreen best-effort
                 continue
             for token, constraints in requests:
-                if (id(module), id(token)) in pre_seen:
-                    continue
-                pre_seen.add((id(module), id(token)))
                 prescreen.append((module, token, constraints))
 
     # same economics as filter_feasible: tiny batches are not worth a
@@ -792,11 +801,13 @@ def _triage_lazy_screens(states: List[GlobalState]) -> None:
     for (module, token, _), verdict in zip(
         prescreen, verdicts[len(reps):]
     ):
-        if verdict is not None:
-            try:
-                module.seed_prescreen(token, bool(verdict))
-            except Exception:  # pragma: no cover - prescreen best-effort
-                pass
+        if verdict is None:
+            _TRIAGE_UNKNOWN_TOKENS.add(token)
+            continue
+        try:
+            module.seed_prescreen(token, bool(verdict))
+        except Exception:  # pragma: no cover - prescreen best-effort
+            pass
 
 
 def _apply_loop_bound(laser, states: List[GlobalState]) -> List[GlobalState]:

@@ -1,0 +1,92 @@
+"""LAZY_SCREEN parking + batched triage (round 5).
+
+Under tpu-batch lane lifting, deferred findings park unscreened and the
+backend triages the frontier in one device feasibility call; the flag
+must always restore, parks must reach settlement, and detection output
+must match the eagerly-screened host path."""
+
+import mythril_tpu.laser.tpu.backend as backend
+from mythril_tpu.analysis import potential_issues
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    PotentialIssuesAnnotation,
+)
+
+from tests.analysis.conftest import SMALL_BATCH_CFG, analyze_contract
+
+_SRC = (
+    "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x20\nCALLDATALOAD\nADD\n"
+    "PUSH1 0x00\nSSTORE\nSTOP"
+)
+
+
+def test_flag_restored_and_detection_parity(monkeypatch):
+    monkeypatch.setattr(
+        backend,
+        "DEFAULT_BATCH_CFG",
+        SMALL_BATCH_CFG._replace(min_device_frontier=0),
+    )
+    assert potential_issues.LAZY_SCREEN is False
+    issues, _sym, strategy = analyze_contract(
+        _SRC, ["IntegerArithmetics"], timeout=120
+    )
+    # the lift ran (device participated) and the flag did not leak
+    assert strategy.device_steps_retired > 0
+    assert potential_issues.LAZY_SCREEN is False
+    assert "101" in {i.swc_id for i in issues}
+
+
+class _FakeState:
+    def __init__(self, issues):
+        self._ann = PotentialIssuesAnnotation()
+        self._ann.potential_issues = issues
+
+    def get_annotations(self, kind):
+        return iter([self._ann] if kind is PotentialIssuesAnnotation else [])
+
+
+def _issue(screened, key=None):
+    issue = PotentialIssue(
+        contract="C",
+        function_name="f",
+        address=1,
+        swc_id="101",
+        title="t",
+        bytecode="",
+        detector=None,
+        screened=screened,
+        screen_key=key,
+    )
+    return issue
+
+
+def test_triage_marks_unscreened_without_device(monkeypatch):
+    # below the dispatch floor: parks are marked screened and kept —
+    # settlement decides, nothing is culled without a device proof
+    monkeypatch.setattr(backend, "_warmup_done", set())
+    parked = [_issue(False), _issue(False)]
+    state = _FakeState(list(parked))
+    backend._triage_lazy_screens([state])
+    assert all(issue.screened for issue in parked)
+    assert state._ann.potential_issues == parked
+
+
+def test_triage_strikes_disable_dispatch(monkeypatch):
+    calls = []
+
+    def fake_batch(sets, flips=384):
+        calls.append(len(sets))
+        return [None] * len(sets)
+
+    monkeypatch.setattr(backend.solver_jax, "feasibility_batch", fake_batch)
+    monkeypatch.setattr(backend, "_warmup_done", {"warm"})
+    monkeypatch.setattr(backend, "_TRIAGE_STRIKES", [0])
+    n = backend.MIN_DEVICE_SOLVE_BATCH
+
+    def frontier():
+        return [_FakeState([_issue(False) for _ in range(n)])]
+
+    backend._triage_lazy_screens(frontier())   # strike 1
+    backend._triage_lazy_screens(frontier())   # strike 2 -> cutoff
+    backend._triage_lazy_screens(frontier())   # must not dispatch
+    assert len(calls) == 2
