@@ -143,7 +143,8 @@ def _steady_analysis(
     detection + witness solving) measured with the SteadyStateMeter —
     the window opens at the first message-call round (creation excluded)
     and closes after fire_lasers, for BOTH engines identically.  Returns
-    (meter, sorted swc ids)."""
+    (meter, sorted swc ids, device fork children pruned by the static
+    pass — 0 for host strategies)."""
     from mythril_tpu.analysis.security import fire_lasers
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.ethereum.evmcontract import EVMContract
@@ -173,7 +174,14 @@ def _steady_analysis(
     )
     issues = fire_lasers(sym)
     meter.close()
-    return meter, sorted({i.swc_id for i in issues})
+    pruned = 0
+    if strategy == "tpu-batch":
+        from mythril_tpu.laser.tpu.backend import find_tpu_strategy
+
+        tpu_strategy = find_tpu_strategy(sym.laser.strategy)
+        if tpu_strategy is not None:
+            pruned = tpu_strategy.static_pruned_lanes
+    return meter, sorted({i.swc_id for i in issues}), pruned
 
 
 def _device_states_per_sec(code: bytes, lanes: int) -> float:
@@ -274,6 +282,11 @@ def _emit(progress: dict) -> None:
                 else round(bec_rate, 1),
                 "bectoken_vs_host": _ratio(bec_rate, bec_host),
                 "bectoken_swcs": progress.get("bectoken_swcs"),
+                "static_pass_s": progress.get("static_pass_s"),
+                "static_pruned_lanes": progress.get("static_pruned_lanes"),
+                "integrated_static_pruned_lanes": progress.get(
+                    "integrated_static_pruned_lanes"
+                ),
                 "lanes": progress.get("lanes"),
                 "platform": progress.get("platform", "unknown"),
                 "partial": progress.get("partial", False),
@@ -324,8 +337,8 @@ def _watchdog_main() -> int:
     try:
         with open(progress_path) as f:
             progress = json.load(f)
-    except Exception:
-        pass
+    except (OSError, ValueError):
+        pass  # missing or corrupt progress file -> fresh run
     finally:
         for p in (progress_path, progress_path + ".tmp"):
             try:
@@ -366,7 +379,7 @@ def main() -> int:
 
     progress = {"protocol": "steady-state-v1"}
     _phase("host baseline (stress contract, bfs tx=2 budget=60)")
-    host_meter, _ = _steady_analysis(
+    host_meter, _, _ = _steady_analysis(
         creation_hex, runtime.hex(), "bfs", 2, 60, "BECStress"
     )
     progress["host_states_per_sec"] = host_meter.states_per_s
@@ -385,11 +398,12 @@ def main() -> int:
     _checkpoint(progress)
 
     _phase("integrated tpu-batch pipeline (stress contract, tx=2 budget=60)")
-    meter, integrated_swcs = _steady_analysis(
+    meter, integrated_swcs, integrated_pruned = _steady_analysis(
         creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
     )
     progress["integrated_states_per_sec"] = meter.states_per_s
     progress["integrated_swcs"] = integrated_swcs
+    progress["integrated_static_pruned_lanes"] = integrated_pruned
     _checkpoint(progress)
 
     # the BASELINE.md north-star workload: the faithful BECToken
@@ -412,17 +426,24 @@ def main() -> int:
         + bec_runtime.hex()
     )
     _phase("host baseline (BECToken, bfs tx=3 budget=120)")
-    bec_host_meter, _ = _steady_analysis(
+    bec_host_meter, _, _ = _steady_analysis(
         bec_creation, bec_runtime.hex(), "bfs", 3, 120, "BECToken"
     )
     progress["bectoken_host_states_per_sec"] = bec_host_meter.states_per_s
     _checkpoint(progress)
     _phase("integrated tpu-batch pipeline (BECToken, tx=3 budget=120)")
-    bec_meter, bec_swcs = _steady_analysis(
+    bec_meter, bec_swcs, bec_pruned = _steady_analysis(
         bec_creation, bec_runtime.hex(), "tpu-batch", 3, 120, "BECToken"
     )
     progress["bectoken_states_per_sec"] = bec_meter.states_per_s
     progress["bectoken_swcs"] = bec_swcs
+    # cost/benefit of the static pre-analysis pass: its cumulative wall
+    # time across every analysis in this process, and the device fork
+    # children it pruned on the north-star BECToken row
+    progress["static_pruned_lanes"] = bec_pruned
+    from mythril_tpu.analysis import static_pass
+
+    progress["static_pass_s"] = round(static_pass.stats()["wall_s"], 4)
     _checkpoint(progress)
     _phase("done")
 

@@ -1,8 +1,9 @@
 """Detection module registry.
 
 Parity surface: mythril/analysis/module/loader.py — a singleton holding
-the 14 built-in detectors (declared as a table, instantiated lazily) plus
-anything third-party plugins register at runtime."""
+the 14 built-in detectors (declared as a table, instantiated lazily), the
+env-gated static-analysis probe, plus anything third-party plugins
+register at runtime."""
 
 from typing import List, Optional
 
@@ -43,9 +44,22 @@ class ModuleLoader(object, metaclass=Singleton):
         self._load_builtins()
 
     def _load_builtins(self) -> None:
+        import os
         from importlib import import_module
 
-        for module_path, class_name in _BUILTIN_DETECTORS:
+        detectors = list(_BUILTIN_DETECTORS)
+        # the static-pass probe is a POST module: merely registering it
+        # forces statespace retention (analysis/symbolic.py), so it only
+        # joins the registry when explicitly enabled — the default SWC
+        # finding set stays byte-identical with the static pass on or off
+        if os.environ.get("MYTHRIL_TPU_STATIC_PROBE"):
+            detectors.append(
+                (
+                    "mythril_tpu.analysis.module.modules.static_probe",
+                    "StaticAnalysisProbe",
+                )
+            )
+        for module_path, class_name in detectors:
             cls = getattr(import_module(module_path), class_name)
             self._modules.append(cls())
 

@@ -1,0 +1,96 @@
+"""Static bytecode pre-analysis pass (CFG recovery + stack abstract
+interpretation) feeding the host LASER engine and the TPU batch engine.
+
+Runs ONCE per contract before symbolic execution:
+
+1. basic-block decomposition with a verified JUMPDEST set (blocks.py);
+2. a stack-height + constant-propagation abstract interpreter resolving
+   PUSH-fed and constant-folded computed JUMP/JUMPI targets into a sound
+   over-approximate successor table (absint.py);
+3. per-block facts — reachability from dispatch, static stack delta,
+   interesting-op distance, must-revert/dead blocks — exported as dense
+   NumPy tables (tables.py).
+
+Consumers: laser/tpu/batch.py make_code_bank (device jumpdest +
+must-revert bitmaps), laser/evm/instructions.py (host JUMP/JUMPI fast
+path over resolved targets), laser/evm/strategy/basic.py
+(StaticDistanceWeightedStrategy), and the detection probe (probe.py).
+
+Results are cached per bytecode; ``stats()`` exposes the cumulative
+analysis wall time for the bench protocol (``static_pass_s``).
+
+See docs/STATIC_PASS.md for the lattice and the soundness argument.
+"""
+
+import time
+from collections import OrderedDict
+from typing import Union
+
+from mythril_tpu.analysis.static_pass.blocks import (
+    INTERESTING,
+    BasicBlock,
+    Insn,
+    decompose,
+    scan,
+)
+from mythril_tpu.analysis.static_pass.tables import (
+    INTEREST_INF,
+    MAX_SUCC,
+    StaticAnalysis,
+    build,
+)
+
+__all__ = [
+    "INTERESTING",
+    "INTEREST_INF",
+    "MAX_SUCC",
+    "BasicBlock",
+    "Insn",
+    "StaticAnalysis",
+    "analyze",
+    "build",
+    "decompose",
+    "scan",
+    "reset_stats",
+    "stats",
+]
+
+# analyses are small (a few dense arrays per contract) but the cache must
+# not grow without bound in a long-lived service process
+_CACHE_CAP = 512
+_CACHE: "OrderedDict[bytes, StaticAnalysis]" = OrderedDict()
+
+_STATS = {"wall_s": 0.0, "contracts": 0, "cache_hits": 0}
+
+
+def _to_bytes(code: Union[bytes, bytearray, str]) -> bytes:
+    if isinstance(code, str):
+        code = bytes.fromhex(code[2:] if code.startswith("0x") else code)
+    return bytes(code)
+
+
+def analyze(code: Union[bytes, bytearray, str]) -> StaticAnalysis:
+    """Cached entry point: bytecode (bytes or hex string) -> tables."""
+    code = _to_bytes(code)
+    hit = _CACHE.get(code)
+    if hit is not None:
+        _CACHE.move_to_end(code)
+        _STATS["cache_hits"] += 1
+        return hit
+    t0 = time.perf_counter()
+    result = build(code)
+    _STATS["wall_s"] += time.perf_counter() - t0
+    _STATS["contracts"] += 1
+    _CACHE[code] = result
+    while len(_CACHE) > _CACHE_CAP:
+        _CACHE.popitem(last=False)
+    return result
+
+
+def stats() -> dict:
+    """Cumulative pass cost counters (bench protocol: static_pass_s)."""
+    return dict(_STATS)
+
+
+def reset_stats() -> None:
+    _STATS.update(wall_s=0.0, contracts=0, cache_hits=0)

@@ -28,6 +28,7 @@ from mythril_tpu.laser.evm.strategy.basic import (
     DepthFirstSearchStrategy,
     ReturnRandomNaivelyStrategy,
     ReturnWeightedRandomStrategy,
+    StaticDistanceWeightedStrategy,
 )
 from mythril_tpu.laser.evm.strategy.extensions.bounded_loops import (
     BoundedLoopsStrategy,
@@ -49,6 +50,10 @@ def _pick_strategy(name: str) -> Type[BasicSearchStrategy]:
         return ReturnRandomNaivelyStrategy
     if name == "weighted-random":
         return ReturnWeightedRandomStrategy
+    if name == "static-weighted":
+        # biases selection toward states statically close to SSTORE /
+        # CALL-family / SELFDESTRUCT sites (analysis/static_pass/)
+        return StaticDistanceWeightedStrategy
     if name == "tpu-batch":
         # the hybrid host/device backend (laser/tpu/backend.py):
         # LaserEVM.exec delegates message-call rounds to the batched
@@ -196,8 +201,8 @@ class SymExecWrapper:
                 target.set_balance(
                     dynloader.read_balance("{0:#0{1}x}".format(address.value, 42))
                 )
-            except Exception:
-                pass  # initial balance stays symbolic
+            except Exception as e:
+                log.debug("balance fetch failed (%s); stays symbolic", e)
         world_state.put_account(target)
         self.laser.sym_exec(world_state=world_state, target_address=address.value)
 

@@ -24,17 +24,30 @@ _METADATA_MARKERS = (
 
 
 class EvmInstruction:
-    """A disassembled instruction: address, mnemonic, optional argument."""
+    """A disassembled instruction: address, mnemonic, optional argument.
 
-    def __init__(self, address: int, op_code: str, argument: Optional[str] = None):
+    ``truncated`` marks a PUSH whose immediate ran past the end of the
+    bytecode; its argument is zero-padded on the right (EVM semantics:
+    reads past the code end yield zero bytes)."""
+
+    def __init__(
+        self,
+        address: int,
+        op_code: str,
+        argument: Optional[str] = None,
+        truncated: bool = False,
+    ):
         self.address = address
         self.op_code = op_code
         self.argument = argument
+        self.truncated = truncated
 
     def to_dict(self) -> dict:
         result = {"address": self.address, "opcode": self.op_code}
         if self.argument:
             result["argument"] = self.argument
+        if self.truncated:
+            result["truncated"] = True
         return result
 
 
@@ -63,8 +76,17 @@ def disassemble(bytecode: bytes) -> List[dict]:
         match_push = regex_PUSH.match(spec.name)
         if match_push:
             width = int(match_push.group(1))
-            argument = "0x" + bytecode[address + 1 : address + 1 + width].hex()
-            instruction_list.append(EvmInstruction(address, spec.name, argument))
+            data = bytecode[address + 1 : address + 1 + width]
+            # an immediate cut off by the end of the bytecode pads with
+            # zeros on the RIGHT (the EVM reads implicit zero bytes past
+            # the code end); "0x" + data.hex() alone would silently parse
+            # to the wrong (left-aligned) value
+            argument = "0x" + data.hex() + "00" * (width - len(data))
+            instruction_list.append(
+                EvmInstruction(
+                    address, spec.name, argument, truncated=len(data) < width
+                )
+            )
             address += 1 + width
         else:
             instruction_list.append(EvmInstruction(address, spec.name))

@@ -23,11 +23,44 @@ class Disassembly(object):
         self.function_name_to_address: Dict[str, int] = {}
         self.address_to_function_name: Dict[int, str] = {}
         self.enable_online_lookup = enable_online_lookup
+        self._static_analysis = None
+        self._jumpdest_index = None
         self.assign_bytecode(bytecode=code)
+
+    @property
+    def static_analysis(self):
+        """Lazily-built static pre-analysis tables for this bytecode
+        (analysis/static_pass/); None when the code is empty or the pass
+        fails — callers must treat that as "no static facts"."""
+        if self._static_analysis is None and self.bytecode:
+            from mythril_tpu.analysis import static_pass
+
+            try:
+                self._static_analysis = static_pass.analyze(self.bytecode)
+            except Exception:  # degrade: analysis is advisory on the host
+                log.warning(
+                    "static pass failed for bytecode of length %d",
+                    len(self.bytecode),
+                    exc_info=True,
+                )
+        return self._static_analysis
+
+    @property
+    def jumpdest_index(self) -> Dict[int, int]:
+        """byte address -> instruction_list index for every JUMPDEST."""
+        if self._jumpdest_index is None:
+            self._jumpdest_index = {
+                instr["address"]: i
+                for i, instr in enumerate(self.instruction_list)
+                if instr["opcode"] == "JUMPDEST"
+            }
+        return self._jumpdest_index
 
     def assign_bytecode(self, bytecode):
         self.bytecode = bytecode
         self.instruction_list = asm.disassemble(bytecode)
+        self._static_analysis = None
+        self._jumpdest_index = None
         signatures = SignatureDB(enable_online_lookup=self.enable_online_lookup)
         jump_table_indices = asm.find_op_code_sequence(
             [("PUSH1", "PUSH2", "PUSH3", "PUSH4"), ("EQ",)], self.instruction_list

@@ -76,9 +76,17 @@ def add_analysis_flags(parser: argparse.ArgumentParser) -> None:
     group = parser.add_argument_group("analysis")
     group.add_argument(
         "--strategy",
-        choices=("dfs", "bfs", "naive-random", "weighted-random", "tpu-batch"),
+        choices=(
+            "dfs",
+            "bfs",
+            "naive-random",
+            "weighted-random",
+            "static-weighted",
+            "tpu-batch",
+        ),
         default="bfs",
-        help="search strategy (tpu-batch = batched device backend)",
+        help="search strategy (tpu-batch = batched device backend; "
+        "static-weighted = biased toward statically-interesting blocks)",
     )
     group.add_argument("-t", "--transaction-count", type=int, default=2, help="transaction depth")
     group.add_argument("-b", "--loop-bound", type=int, default=3, metavar="N", help="bound loops to N iterations")

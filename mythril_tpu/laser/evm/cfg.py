@@ -1,9 +1,9 @@
 """Control-flow-graph nodes and edges recorded during symbolic execution
 (reference surface: mythril/laser/ethereum/cfg.py)."""
 
+import itertools
 from enum import Enum
 from typing import Dict, List
-
 
 
 class JumpType(Enum):
@@ -21,14 +21,16 @@ class NodeFlags:
     CALL_RETURN = 2
 
 
-gbl_next_uid = 0
+# itertools.count().__next__ is atomic under the GIL, so concurrent node
+# creation (device lift threads + host loop) can never mint duplicate uids
+# the way the old `global gbl_next_uid; gbl_next_uid += 1` pair could
+_next_uid = itertools.count()
 
 
 class Node:
     """A basic-block node in the CFG."""
 
     def __init__(self, contract_name: str, start_addr=0, constraints=None, function_name="unknown"):
-        global gbl_next_uid
         constraints = constraints if constraints else []
         self.contract_name = contract_name
         self.start_addr = start_addr
@@ -36,8 +38,14 @@ class Node:
         self.constraints = constraints
         self.function_name = function_name
         self.flags = 0
-        self.uid = gbl_next_uid
-        gbl_next_uid += 1
+        self.uid = next(_next_uid)
+
+    def __repr__(self) -> str:
+        return (
+            "<Node uid={0.uid} contract={0.contract_name!r} "
+            "start_addr={0.start_addr!r} function={0.function_name!r} "
+            "states={1}>"
+        ).format(self, len(self.states))
 
     def get_cfg_dict(self) -> Dict:
         code_lines = []

@@ -63,6 +63,30 @@ TT256 = 2**256
 TT256M1 = 2**256 - 1
 
 
+def _static_jump_index(global_state: GlobalState):
+    """Instruction index of a MUST-resolved jump destination, else None.
+
+    Consults the static pre-analysis (analysis/static_pass/): when the
+    current JUMP/JUMPI site's destination was constant-folded to a single
+    verified JUMPDEST on every path, the concrete destination is known
+    without concretizing the (by construction concrete) stack operand."""
+    disassembly = global_state.environment.code
+    analysis = getattr(disassembly, "static_analysis", None)
+    if analysis is None:
+        return None
+    instr_list = disassembly.instruction_list
+    pc = global_state.mstate.pc
+    if pc >= len(instr_list):
+        return None
+    site = instr_list[pc]["address"]
+    if site >= analysis.code_len:
+        return None
+    dest = int(analysis.resolved_target[site])
+    if dest < 0:
+        return None
+    return disassembly.jumpdest_index.get(dest)
+
+
 def _as_bitvec(value: Union[int, bool, BitVec, Bool]) -> BitVec:
     if isinstance(value, Bool):
         return If(value, symbol_factory.BitVecVal(1, 256), symbol_factory.BitVecVal(0, 256))
@@ -235,21 +259,32 @@ class Instruction:
     def jump_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         disassembly = global_state.environment.code
+        # static fast path: a MUST-resolved site skips concretization and
+        # destination validation (the pass already verified the JUMPDEST)
+        index = _static_jump_index(global_state)
         try:
-            jump_addr = util.get_concrete_int(state.stack.pop())
-        except TypeError:
-            raise InvalidJumpDestination("Invalid jump argument (symbolic address)")
+            operand = state.stack.pop()
         except IndexError:
             raise StackUnderflowException()
-
-        index = util.get_instruction_index(disassembly.instruction_list, jump_addr)
         if index is None:
-            raise InvalidJumpDestination("JUMP to invalid address")
-        op_code = disassembly.instruction_list[index]["opcode"]
-        if op_code != "JUMPDEST":
-            raise InvalidJumpDestination(
-                "Skipping JUMP to invalid destination (not JUMPDEST): " + str(jump_addr)
+            try:
+                jump_addr = util.get_concrete_int(operand)
+            except TypeError:
+                raise InvalidJumpDestination(
+                    "Invalid jump argument (symbolic address)"
+                )
+
+            index = util.get_instruction_index(
+                disassembly.instruction_list, jump_addr
             )
+            if index is None:
+                raise InvalidJumpDestination("JUMP to invalid address")
+            op_code = disassembly.instruction_list[index]["opcode"]
+            if op_code != "JUMPDEST":
+                raise InvalidJumpDestination(
+                    "Skipping JUMP to invalid destination (not JUMPDEST): "
+                    + str(jump_addr)
+                )
 
         new_state = copy(global_state)
         min_gas, max_gas = get_opcode_gas("JUMP")
@@ -266,15 +301,19 @@ class Instruction:
         min_gas, max_gas = get_opcode_gas("JUMPI")
         states = []
 
+        # static fast path (see jump_): resolved sites skip concretization
+        # and the JUMPDEST re-validation below
+        index = _static_jump_index(global_state)
         op0, condition = state.stack.pop(), state.stack.pop()
-        try:
-            jump_addr = util.get_concrete_int(op0)
-        except TypeError:
-            log.debug("Skipping JUMPI to invalid destination.")
-            global_state.mstate.pc += 1
-            global_state.mstate.min_gas_used += min_gas
-            global_state.mstate.max_gas_used += max_gas
-            return [global_state]
+        if index is None:
+            try:
+                jump_addr = util.get_concrete_int(op0)
+            except TypeError:
+                log.debug("Skipping JUMPI to invalid destination.")
+                global_state.mstate.pc += 1
+                global_state.mstate.min_gas_used += min_gas
+                global_state.mstate.max_gas_used += max_gas
+                return [global_state]
 
         negated = (
             simplify(Not(condition)) if isinstance(condition, Bool) else condition == 0
@@ -300,23 +339,26 @@ class Instruction:
         else:
             log.debug("Pruned unreachable states.")
 
-        # jump-taken case
-        index = util.get_instruction_index(disassembly.instruction_list, jump_addr)
+        # jump-taken case (index already resolved on the static fast path)
         if index is None:
-            log.debug("Invalid jump destination: %s", jump_addr)
-            return states
-        instr = disassembly.instruction_list[index]
-        if instr["opcode"] == "JUMPDEST":
-            if positive_cond:
-                new_state = copy(global_state)
-                new_state.mstate.min_gas_used += min_gas
-                new_state.mstate.max_gas_used += max_gas
-                new_state.mstate.pc = index
-                new_state.mstate.depth += 1
-                new_state.world_state.constraints.append(condi)
-                states.append(new_state)
-            else:
-                log.debug("Pruned unreachable states.")
+            index = util.get_instruction_index(
+                disassembly.instruction_list, jump_addr
+            )
+            if index is None:
+                log.debug("Invalid jump destination: %s", jump_addr)
+                return states
+            if disassembly.instruction_list[index]["opcode"] != "JUMPDEST":
+                return states
+        if positive_cond:
+            new_state = copy(global_state)
+            new_state.mstate.min_gas_used += min_gas
+            new_state.mstate.max_gas_used += max_gas
+            new_state.mstate.pc = index
+            new_state.mstate.depth += 1
+            new_state.world_state.constraints.append(condi)
+            states.append(new_state)
+        else:
+            log.debug("Pruned unreachable states.")
         return states
 
     @StateTransition()

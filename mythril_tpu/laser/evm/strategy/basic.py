@@ -2,7 +2,8 @@
 
 Parity surface: mythril/laser/ethereum/strategy/basic.py — DFS/BFS pop
 opposite ends of the shared work list; the two random strategies draw
-uniformly / weighted by 1/(depth+1)."""
+uniformly / weighted by 1/(depth+1). StaticDistanceWeightedStrategy is
+an addition: it weights by the static pass's interesting-op distance."""
 
 import random
 from typing import List
@@ -48,5 +49,43 @@ class ReturnWeightedRandomStrategy(BasicSearchStrategy):
 
     def get_strategic_global_state(self) -> GlobalState:
         weights = [1 / (state.mstate.depth + 1) for state in self.work_list]
+        chosen = random.choices(range(len(self.work_list)), weights)[0]
+        return self.work_list.pop(chosen)
+
+
+class StaticDistanceWeightedStrategy(BasicSearchStrategy):
+    """Random draw favoring states close to an interesting op.
+
+    Weight is 1/(1+d) where d is the static pass's interest_dist for the
+    basic block containing the state's pc — the block distance to the
+    nearest SSTORE/CALL-family/SELFDESTRUCT site, the places detection
+    modules anchor on. States whose block cannot reach any interesting op
+    (or with no static analysis available) fall back to the depth weight
+    so the strategy degrades to ReturnWeightedRandomStrategy behaviour.
+    """
+
+    @staticmethod
+    def _weight(state: GlobalState) -> float:
+        fallback = 1 / (state.mstate.depth + 1)
+        disassembly = state.environment.code
+        analysis = getattr(disassembly, "static_analysis", None)
+        if analysis is None:
+            return fallback
+        instr_list = disassembly.instruction_list
+        pc = state.mstate.pc
+        if pc >= len(instr_list):
+            return fallback
+        block = analysis.block_at(instr_list[pc]["address"])
+        if block is None:
+            return fallback
+        dist = int(analysis.interest_dist[block])
+        from mythril_tpu.analysis.static_pass import INTEREST_INF
+
+        if dist >= INTEREST_INF:
+            return fallback
+        return 1 / (1 + dist)
+
+    def get_strategic_global_state(self) -> GlobalState:
+        weights = [self._weight(state) for state in self.work_list]
         chosen = random.choices(range(len(self.work_list)), weights)[0]
         return self.work_list.pop(chosen)

@@ -78,5 +78,9 @@ def ensure_compile_cache() -> None:
                 "jax_persistent_cache_min_compile_time_secs",
                 float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
             )
-        except Exception:  # pragma: no cover - cache is best-effort
-            pass
+        except Exception as e:  # pragma: no cover - cache is best-effort
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "jax compile-cache config failed: %s", e
+            )

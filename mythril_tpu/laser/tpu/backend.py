@@ -118,6 +118,10 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # storage-ring spill drains performed mid-round (lanes that would
         # have freeze-trapped at ring overflow before round 5)
         self.ss_drains = 0
+        # JUMPI fork children suppressed on device because their taken
+        # destination enters a static must-revert block (engine.py
+        # prune_child; bench protocol field static_pruned_lanes)
+        self.static_pruned_lanes = 0
         # start compiling the device kernels NOW on a background thread:
         # the creation transaction and the first host rounds overlap the
         # XLA compile, and exec_batch switches to device rounds the
@@ -806,8 +810,8 @@ def _triage_lazy_screens(states: List[GlobalState]) -> None:
             continue
         try:
             module.seed_prescreen(token, bool(verdict))
-        except Exception:  # pragma: no cover - prescreen best-effort
-            pass
+        except Exception as e:  # pragma: no cover - prescreen best-effort
+            log.debug("prescreen seed for %s failed: %s", module, e)
 
 
 def _apply_loop_bound(laser, states: List[GlobalState]) -> List[GlobalState]:
@@ -879,6 +883,14 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
     host_ops = host_op_bytes(laser)
     replayers = tape_replayers_for(laser)
     val_replayers = value_replayers_for(laser)
+    # static must-revert fork pruning is sound only when the suppressed
+    # child is truly unobservable: outermost reverting frames are
+    # discarded by transaction finalization, but a REVERT hook would
+    # have fired on the pruned path, and track_gas asserts gas totals
+    # the pruned path never accumulates — gate on both
+    prune_revert = not track_gas and not (
+        laser.pre_hooks.get("REVERT") or laser.post_hooks.get("REVERT")
+    )
     seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
     final_states: List[GlobalState] = []
     budget_deadline = (
@@ -961,6 +973,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             freeze_errors=True,
             tape_replayers=replayers,
             value_replayers=val_replayers,
+            prune_revert=prune_revert,
         )
         packed_states = []
         for state in to_pack:
@@ -1013,6 +1026,9 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         strategy.device_rounds += 1
         strategy.device_steps_retired += int(np.asarray(out.steps).sum())
         strategy.ss_drains += bridge.ss_drain_count
+        strategy.static_pruned_lanes += int(
+            np.asarray(out.static_pruned)[np.asarray(out.alive)].sum()
+        )
 
         # measurement parity: instructions retired on device feed the same
         # coverage accounting the host's execute_state hook does

@@ -108,6 +108,13 @@ class CodeBank(NamedTuple):
     # nothing, and the overflow trap would bounce write-heavy lanes to
     # the host for no detection benefit (advisor r3)
     record_storage_events: jnp.ndarray  # bool[] scalar
+    # static-pass must-revert bitmap (analysis/static_pass/): a byte-pc
+    # flagged True starts/continues a block whose every execution runs
+    # only device-pure ops into REVERT. With prune_revert set, JUMPI fork
+    # children landing on such a pc in an OUTERMOST frame are suppressed
+    # instead of forked (engine.py) — the host never sees the lane.
+    must_revert: jnp.ndarray  # bool[n_codes, code_len]
+    prune_revert: jnp.ndarray  # bool[] scalar
 
 
 class Env(NamedTuple):
@@ -189,6 +196,12 @@ class StateBatch(NamedTuple):
     origin_sym: jnp.ndarray  # i32[L]
     balance_sym: jnp.ndarray  # i32[L]
     seed_id: jnp.ndarray  # i32[L] host-side id of the seeding state
+    # True when the lane's host state is an outermost (transaction-level)
+    # frame — the gate for static must-revert pruning: a reverting
+    # outermost frame is discarded by _finalize_transaction with no
+    # observable effect, so its lane may be killed at fork time
+    outermost: jnp.ndarray  # bool[L]
+    static_pruned: jnp.ndarray  # i32[L] fork children suppressed by the static pass
 
 
 def batch_shapes(cfg: BatchConfig) -> dict:
@@ -273,6 +286,8 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "origin_sym": ((L,), np.int32),
         "balance_sym": ((L,), np.int32),
         "seed_id": ((L,), np.int32),
+        "outermost": ((L,), np.bool_),
+        "static_pruned": ((L,), np.int32),
     }
 
 
@@ -287,36 +302,45 @@ def empty_batch(cfg: BatchConfig) -> StateBatch:
 
 def make_code_bank(
     codes, code_len: int, host_ops=None, freeze_errors=False,
-    record_storage_events=False,
+    record_storage_events=False, prune_revert=False,
 ) -> CodeBank:
     """Host helper: list of bytes objects -> CodeBank (pads / analyses).
 
     ``host_ops`` is an optional iterable of opcode bytes that must
-    freeze-trap back to the host (hybrid-loop mode).
+    freeze-trap back to the host (hybrid-loop mode). ``prune_revert``
+    arms static must-revert fork pruning (see CodeBank.must_revert).
+
+    The JUMPDEST and must-revert bitmaps come from the static
+    pre-analysis pass (analysis/static_pass/, one cached analysis per
+    bytecode); only the PUSH-immediate pre-decode stays inline because
+    its u32-digit layout is device-specific.
 
     The row count pads to a power of two so the jitted step kernel sees a
     stable CodeBank shape across analyses (one compile per bucket, not one
     per distinct contract count)."""
+    from mythril_tpu.analysis import static_pass
+
     n = 1
     while n < len(codes):
         n <<= 1
     code = np.zeros((n, code_len), dtype=np.uint8)
     lens = np.zeros((n,), dtype=np.int32)
     jd = np.zeros((n, code_len), dtype=bool)
+    mrev = np.zeros((n, code_len), dtype=bool)
     pimm = np.zeros((n, code_len, words.NDIGITS), dtype=np.uint32)
     for i, c in enumerate(codes):
         if len(c) > code_len:
             raise ValueError(f"code {i} length {len(c)} exceeds bank width {code_len}")
         code[i, : len(c)] = np.frombuffer(bytes(c), dtype=np.uint8)
         lens[i] = len(c)
-        # Mark JUMPDESTs that are real instruction starts (not push data)
-        # and pre-decode PUSH immediates (truncated pushes zero-pad on the
+        analysis = static_pass.analyze(bytes(c))
+        jd[i, : len(c)] = analysis.jumpdest_bitmap
+        mrev[i, : len(c)] = analysis.must_revert_pc
+        # Pre-decode PUSH immediates (truncated pushes zero-pad on the
         # right, matching the EVM's implicit zero bytes past code end).
         pc = 0
         while pc < len(c):
             op = c[pc]
-            if op == 0x5B:
-                jd[i, pc] = True
             if 0x60 <= op <= 0x7F:
                 k = op - 0x5F
                 imm = bytes(c[pc + 1 : pc + 1 + k])
@@ -335,6 +359,8 @@ def make_code_bank(
         host_ops=jnp.asarray(hops),
         freeze_errors=jnp.asarray(bool(freeze_errors)),
         record_storage_events=jnp.asarray(bool(record_storage_events)),
+        must_revert=jnp.asarray(mrev),
+        prune_revert=jnp.asarray(bool(prune_revert)),
     )
 
 
@@ -393,6 +419,7 @@ def _fill_lane(
     symbolic_callvalue: bool = False,
     symbolic_balance: bool = False,
     seed_id: int = 0,
+    outermost: bool = True,
 ) -> None:
     C = np_batch["calldata"].shape[1]
     if len(calldata) > C:
@@ -444,6 +471,8 @@ def _fill_lane(
     np_batch["calldata_symbolic"][lane] = symbolic_calldata
     np_batch["storage_symbolic"][lane] = symbolic_storage
     np_batch["seed_id"][lane] = seed_id
+    np_batch["outermost"][lane] = outermost
+    np_batch["static_pruned"][lane] = 0
     from mythril_tpu.laser.tpu import symtape
 
     if symbolic_calldata:

@@ -139,10 +139,15 @@ class DeviceBridge:
         freeze_errors: bool = False,
         tape_replayers=None,
         value_replayers=None,
+        prune_revert: bool = False,
     ):
         self.cfg = cfg
         self.host_ops = host_ops
         self.freeze_errors = freeze_errors
+        # arm static must-revert fork pruning in the step kernel (the
+        # backend only sets this when no REVERT hook is registered and
+        # gas accounting is not being tracked — see exec_batch)
+        self.prune_revert = prune_revert
         # symtape op -> [(detection module, EVM opcode name)]: batch-aware
         # modules whose pre-hook is replayed over device-allocated tape
         # nodes at lift time instead of freeze-trapping the opcode
@@ -257,6 +262,7 @@ class DeviceBridge:
                 self.tape_replayers.get("SSTORE")
                 or self.tape_replayers.get("SLOAD")
             ),
+            prune_revert=self.prune_revert,
         )
         st = transfer.batch_to_device(self._np_batch, self.cfg)
         return cb, st
@@ -303,6 +309,13 @@ class DeviceBridge:
         np_batch["pc"][lane] = pc_byte
         np_batch["code_id"][lane] = code_id
         np_batch["seed_id"][lane] = seed_id
+        # outermost = transaction-level frame (no caller state): the only
+        # frames static must-revert pruning may kill at fork time
+        np_batch["outermost"][lane] = (
+            state.transaction_stack[-1][1] is None
+            if state.transaction_stack
+            else False
+        )
 
         gas_left = max(0, int(mstate.gas_limit) - int(mstate.min_gas_used))
         np_batch["gas_left"][lane] = min(gas_left, 0xFFFFFFFF)

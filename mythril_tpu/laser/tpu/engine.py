@@ -862,7 +862,20 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # a lane that will OOG on the JUMPI itself must not consume a fork
     # rank (it would spuriously starve a later forking lane); JUMPI's cost
     # is purely static, so the check is exact here
-    fork_base = path_append & dest_ok & (st.gas_left >= static_gas)
+    fork_want = path_append & dest_ok & (st.gas_left >= static_gas)
+    # static must-revert pruning: when the taken branch enters a block the
+    # static pass proved runs only device-pure ops into REVERT, the child
+    # is suppressed instead of forked — but only for outermost frames
+    # (a reverting outermost state is discarded by the host's transaction
+    # finalization with no observable effect, so no hook, no solver call,
+    # and no lane are ever spent on it). Armed per-analysis by the
+    # backend (prune_revert gate in exec_batch).
+    prune_child = (
+        cb.prune_revert
+        & st.outermost
+        & cb.must_revert[st.code_id, jnp.clip(dest32, 0, CL - 1)]
+    )
+    fork_base = fork_want & ~prune_child
     free = ~st.alive
     nfree = jnp.sum(free.astype(I32))
     free_rank = jnp.cumsum(free.astype(I32)) - 1
@@ -1167,6 +1180,12 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         origin_sym=st.origin_sym,
         balance_sym=st.balance_sym,
         seed_id=st.seed_id,
+        outermost=st.outermost,
+        # count each suppressed child on the lane that would have forked
+        # it — the path-tape append still commits (the fall-through keeps
+        # ¬cond), only the taken-branch lane is elided
+        static_pruned=st.static_pruned
+        + (fork_want & prune_child & committed).astype(I32),
     )
 
     # ------------------------------------------------------------------
@@ -1207,6 +1226,9 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             jd_ring=fst.jd_ring.at[lane, ring_idx].set(
                 jnp.where(child_mask, dest_g, fst.jd_ring[lane, ring_idx])
             ),
+            # the gather copied the parent's prune counter; zero it on
+            # the child so each suppressed fork is counted exactly once
+            static_pruned=jnp.where(child_mask, 0, fst.static_pruned),
         )
 
     return jax.lax.cond(jnp.any(fork_do), do_fork, lambda _: nst, None)

@@ -4,11 +4,14 @@ Third-party packages register under the ``mythril_tpu.plugins`` entry
 point group; discovery is lazy and cached on the singleton.
 """
 
+import logging
 from importlib import metadata
 from typing import Any, Dict, List, Optional
 
 from mythril_tpu.plugin.interface import MythrilPlugin
 from mythril_tpu.support.support_utils import Singleton
+
+log = logging.getLogger(__name__)
 
 
 class PluginDiscovery(object, metaclass=Singleton):
@@ -33,8 +36,8 @@ class PluginDiscovery(object, metaclass=Singleton):
                     plugins[ep.name] = ep.load()
                 except Exception:  # a broken plugin must not break the CLI
                     plugins[ep.name] = None
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("entry-point discovery unavailable: %s", e)
         self._plugins = plugins
         return plugins
 

@@ -85,7 +85,7 @@ class NativeSat:
             if getattr(self, "_s", None):
                 self._lib.tsat_free(self._s)
                 self._s = None
-        except Exception:
+        except Exception:  # noqa - __del__ during interpreter teardown must never raise
             pass
 
     def new_var(self) -> int:

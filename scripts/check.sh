@@ -2,8 +2,10 @@
 # Repo quality gate (VERDICT r3 #10; reference parity: tox.ini mypy +
 # CircleCI black). mypy/black are not installable in this image, so the
 # gate is: stdlib byte-compilation of every module, the ast-based lint
-# (scripts/lint.py: unused imports + whitespace discipline), and a
-# pytest collection sanity pass. CPU-only and tunnel-safe.
+# (scripts/lint.py: unused imports, undefined names, mutable defaults,
+# swallowed exceptions, whitespace discipline — over mythril_tpu/ AND
+# tests/), a pytest collection sanity pass, and the static-pass golden
+# fixture tests (fast, no symbolic execution). CPU-only and tunnel-safe.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -19,5 +21,12 @@ python scripts/lint.py
 echo "== pytest collection =="
 python -m pytest tests/ -q --collect-only > /dev/null
 echo "collection ok"
+
+echo "== static-pass golden tests =="
+# -k keeps this to the fast fixture/decode tests; the symbolic-execution
+# property tests in the same files run with the full suite
+python -m pytest tests/analysis/test_static_pass.py \
+    tests/analysis/test_disassembler_truncated.py \
+    -q -p no:cacheprovider -k "golden or cache or push or scan"
 
 echo "ALL CHECKS PASSED"

@@ -11,6 +11,12 @@ standard library can check reliably:
     must be bound in some enclosing scope or be a builtin; deliberately
     order-insensitive so use-before-def never false-positives, and
     files with star imports are exempt)
+  - no mutable default arguments (a list/dict/set literal or bare
+    list()/dict()/set() call as a def/lambda default is shared across
+    calls; noqa exempts)
+  - no swallowed exceptions (a catch-all handler — bare ``except:``,
+    ``except Exception``/``BaseException`` — whose body is only
+    ``pass``/``...`` hides real failures; noqa exempts)
   - no tabs in indentation, no trailing whitespace, newline at EOF
 
 Run via scripts/check.sh. Exit 0 = clean.
@@ -243,6 +249,67 @@ def unused_imports(tree: ast.AST, source: str, is_init: bool):
     return out
 
 
+_MUTABLE_CONSTRUCTORS = {"list", "dict", "set"}
+
+
+def _noqa(source_lines, lineno: int) -> bool:
+    line = source_lines[lineno - 1] if lineno - 1 < len(source_lines) else ""
+    return "noqa" in line
+
+
+def mutable_defaults(tree: ast.AST, source: str):
+    """(lineno, desc) pairs for def/lambda defaults evaluated once and
+    shared across calls: list/dict/set literals or bare list()/dict()/
+    set() constructor calls."""
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        a = node.args
+        for default in list(a.defaults) + [
+            d for d in a.kw_defaults if d is not None
+        ]:
+            mutable = isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in _MUTABLE_CONSTRUCTORS
+                and not default.args
+                and not default.keywords
+            )
+            if mutable and not _noqa(lines, default.lineno):
+                out.append((default.lineno, "mutable default argument"))
+    return sorted(set(out))
+
+
+def swallowed_exceptions(tree: ast.AST, source: str):
+    """(lineno, desc) pairs for catch-all except handlers whose body is
+    only pass/... — errors disappear without a trace. Handlers that log,
+    re-raise, return a fallback, or catch a specific exception type are
+    all fine."""
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        catch_all = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")
+        )
+        body_silent = all(
+            isinstance(stmt, ast.Pass)
+            or (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis
+            )
+            for stmt in node.body
+        )
+        if catch_all and body_silent and not _noqa(lines, node.lineno):
+            out.append((node.lineno, "swallowed exception (catch-all, pass body)"))
+    return sorted(set(out))
+
+
 def main() -> int:
     problems = []
     n_files = 0
@@ -261,6 +328,10 @@ def main() -> int:
             problems.append(f"{rel}:{lineno}: unused import '{name}'")
         for lineno, name in undefined_names(tree, source):
             problems.append(f"{rel}:{lineno}: undefined name '{name}'")
+        for lineno, desc in mutable_defaults(tree, source):
+            problems.append(f"{rel}:{lineno}: {desc}")
+        for lineno, desc in swallowed_exceptions(tree, source):
+            problems.append(f"{rel}:{lineno}: {desc}")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")
             if stripped != stripped.rstrip():

@@ -1,0 +1,61 @@
+"""Truncated-PUSH hardening (disassembler/asm.py + static_pass.scan):
+a PUSH immediate cut off by the end of the bytecode must zero-pad on the
+RIGHT (EVM reads implicit zero bytes past the code end) and flag the
+instruction, never raise or silently left-align the value."""
+
+from mythril_tpu.analysis.static_pass import build, scan
+from mythril_tpu.disassembler.asm import disassemble
+
+
+def test_push32_truncated_to_one_byte():
+    # PUSH32 with only 1 of 32 immediate bytes present
+    code = bytes([0x7F, 0xAA])
+    instrs = disassemble(code)
+    assert len(instrs) == 1
+    ins = instrs[0]
+    assert ins["opcode"] == "PUSH32"
+    assert ins["argument"] == "0x" + "aa" + "00" * 31
+    assert ins["truncated"] is True
+    # the padded value is the EVM semantics: 0xaa << 248, not 0xaa
+    assert int(ins["argument"], 16) == 0xAA << 248
+
+
+def test_push32_truncated_to_31_bytes():
+    imm = bytes(range(1, 32))  # 31 of 32 bytes
+    code = bytes([0x7F]) + imm
+    instrs = disassemble(code)
+    assert len(instrs) == 1
+    ins = instrs[0]
+    assert ins["opcode"] == "PUSH32"
+    assert ins["argument"] == "0x" + imm.hex() + "00"
+    assert ins["truncated"] is True
+    assert int(ins["argument"], 16) == int.from_bytes(imm + b"\x00", "big")
+
+
+def test_push1_truncated_empty_immediate():
+    # PUSH1 as the very last byte: zero bytes of immediate remain
+    code = bytes([0x60])
+    instrs = disassemble(code)
+    assert len(instrs) == 1
+    assert instrs[0]["opcode"] == "PUSH1"
+    assert instrs[0]["argument"] == "0x00"
+    assert instrs[0]["truncated"] is True
+
+
+def test_complete_push_not_flagged():
+    code = bytes([0x7F]) + bytes(32) + bytes([0x60, 0x01, 0x00])
+    instrs = disassemble(code)
+    assert [i["opcode"] for i in instrs] == ["PUSH32", "PUSH1", "STOP"]
+    assert all("truncated" not in i for i in instrs)
+
+
+def test_static_pass_scan_matches_disassembler():
+    # the static pass decodes at the same boundaries with the same
+    # zero-pad semantics and surfaces the per-analysis flag
+    code = bytes([0x60, 0x01, 0x7F]) + b"\xBB"
+    insns = scan(code)
+    assert [(i.pc, i.op) for i in insns] == [(0, 0x60), (2, 0x7F)]
+    assert insns[0].imm == 1 and insns[0].truncated is False
+    assert insns[1].imm == 0xBB << 248 and insns[1].truncated is True
+    assert bool(build(code).has_truncated_push)
+    assert not bool(build(bytes([0x60, 0x01, 0x00])).has_truncated_push)

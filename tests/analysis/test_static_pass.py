@@ -1,0 +1,224 @@
+"""Static pre-analysis pass (analysis/static_pass/): golden CFG fixtures
+for the bench_contracts corpus, the over-approximation property against
+the dynamic CFG recorded during a symbolic run, detection-parity with the
+pass disabled, and the no-host-concretization guarantee on statically
+resolved jumps."""
+
+import logging
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mythril_tpu.analysis.static_pass import INTEREST_INF, analyze, build
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.laser.evm.cfg import JumpType
+
+logging.getLogger().setLevel(logging.ERROR)
+
+BENCH = Path(__file__).resolve().parent.parent.parent / "bench_contracts"
+
+
+def bench_code(name: str) -> bytes:
+    return assemble((BENCH / (name + ".asm")).read_text())
+
+
+# -- golden fixtures ----------------------------------------------------------
+#
+# Hand-checked against the assembly sources. Block indices are in start-
+# address order; successor sets are block indices and include fall-through
+# edges; dist is the interesting-op distance (SSTORE/CALL-family/
+# SELFDESTRUCT), INTEREST_INF when no interesting op is reachable.
+
+def test_golden_bectoken():
+    a = build(bench_code("bectoken"))
+    assert a.n_blocks == 11
+    assert not a.has_unresolved_jumps and not a.has_truncated_push
+    assert [(b.start, b.end) for b in a.blocks] == [
+        (0, 17), (17, 18), (18, 35), (35, 43), (43, 49), (49, 67),
+        (67, 76), (76, 85), (85, 114), (114, 125), (125, 131),
+    ]
+    jd = np.nonzero(np.asarray(a.jumpdest_bitmap))[0].tolist()
+    assert jd == [18, 76, 114, 125]
+    # dispatch forks to STOP fall-through and the batch body; each require
+    # guard conditionally reaches the shared revert block (10); the loop
+    # header (7) and latch (8) cycle; everything is reachable, nothing dead
+    expected_succ = {0: {1, 2}, 1: set(), 2: {3, 10}, 3: {4, 10},
+                     4: {5, 10}, 5: {6, 10}, 6: {7}, 7: {8, 9},
+                     8: {7}, 9: set(), 10: set()}
+    for i, want in expected_succ.items():
+        assert a.successors(i) == want, f"block {i}"
+        assert not bool(a.succ_unknown[i])
+    assert all(bool(a.reachable[i]) for i in range(a.n_blocks))
+    assert not any(bool(a.dead[i]) for i in range(a.n_blocks))
+    # block 10 is the shared `rev:` trampoline (JUMPDEST PUSH PUSH REVERT)
+    assert [i for i in range(a.n_blocks) if a.must_revert[i]] == [10]
+    assert not any(bool(a.must_fail[i]) for i in range(a.n_blocks))
+    # every JUMP/JUMPI is PUSH2-fed -> a singleton MUST-resolved target
+    resolved = {pc: int(a.resolved_target[pc])
+                for pc in range(a.code_len) if int(a.resolved_target[pc]) >= 0}
+    assert resolved == {16: 18, 34: 125, 42: 125, 48: 125,
+                        66: 125, 84: 114, 113: 76}
+    # the loop body (SSTORE inside) is distance 0; the dispatch is farthest
+    assert int(a.interest_dist[6]) == 0 and int(a.interest_dist[8]) == 0
+    assert int(a.interest_dist[0]) == 5
+    assert int(a.interest_dist[1]) >= INTEREST_INF  # bare STOP
+
+
+def test_golden_token():
+    a = build(bench_code("token"))
+    assert a.n_blocks == 3
+    assert not a.has_unresolved_jumps
+    assert [(b.start, b.end) for b in a.blocks] == [(0, 17), (17, 18), (18, 58)]
+    assert a.successors(0) == {1, 2}
+    assert a.successors(1) == set() and a.successors(2) == set()
+    assert np.nonzero(np.asarray(a.jumpdest_bitmap))[0].tolist() == [18]
+    resolved = {pc: int(a.resolved_target[pc])
+                for pc in range(a.code_len) if int(a.resolved_target[pc]) >= 0}
+    assert resolved == {16: 18}
+    assert [int(a.stack_delta[i]) for i in range(3)] == [1, 0, -1]
+    assert int(a.interest_dist[2]) == 0  # xfer body holds the SSTOREs
+
+
+def test_golden_multiowner():
+    a = build(bench_code("multiowner"))
+    assert a.n_blocks == 9
+    assert not a.has_unresolved_jumps
+    expected_succ = {0: {1, 4}, 1: {2, 6}, 2: {3, 5}, 3: set(), 4: set(),
+                     5: set(), 6: {7, 8}, 7: set(), 8: set()}
+    for i, want in expected_succ.items():
+        assert a.successors(i) == want, f"block {i}"
+    resolved = {pc: int(a.resolved_target[pc])
+                for pc in range(a.code_len) if int(a.resolved_target[pc]) >= 0}
+    assert resolved == {16: 40, 27: 59, 38: 47, 70: 73}
+    # block 7 ends in SELFDESTRUCT: interesting at distance 0; the owner
+    # check block (6) is one hop away
+    assert int(a.interest_dist[7]) == 0
+    assert int(a.interest_dist[6]) == 1
+    assert not any(bool(a.must_revert[i]) for i in range(a.n_blocks))
+
+
+def test_analyze_cache_and_stats():
+    from mythril_tpu.analysis import static_pass
+
+    static_pass.reset_stats()
+    code = bench_code("token")
+    a1 = analyze(code)
+    a2 = analyze(code)
+    assert a1 is a2  # cached
+    s = static_pass.stats()
+    assert s["contracts"] >= 1 and s["cache_hits"] >= 1
+    assert s["wall_s"] > 0.0
+
+
+# -- dynamic-CFG over-approximation property ----------------------------------
+
+def _make_creation(runtime_hex: str) -> str:
+    n = len(runtime_hex) // 2
+    src = (
+        f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\nPUSH2 {n}\n"
+        "PUSH1 0x00\nRETURN\ncode:"
+    )
+    return assemble(src).hex() + runtime_hex
+
+
+def _sym_exec(name: str, strategy: str = "bfs", tx_count: int = 1):
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+
+    runtime = bench_code(name).hex()
+    contract = EVMContract(
+        code=runtime, creation_code=_make_creation(runtime), name=name
+    )
+    return SymExecWrapper(
+        contract,
+        address=0x1234,
+        strategy=strategy,
+        execution_timeout=120,
+        transaction_count=tx_count,
+        max_depth=128,
+    )
+
+
+@pytest.mark.parametrize("name", ["bectoken", "multiowner"])
+def test_successor_table_over_approximates_dynamic_cfg(name):
+    """Every JUMP/JUMPI edge the symbolic engine actually takes must be
+    present in the static successor table (soundness: the MAY relation
+    over-approximates the dynamic CFG)."""
+    sym = _sym_exec(name)
+    analysis = build(bench_code(name))
+
+    checked = 0
+    for edge in sym.edges:
+        if edge.type not in (JumpType.UNCONDITIONAL, JumpType.CONDITIONAL):
+            continue
+        src_node = sym.nodes[edge.node_from]
+        dst_node = sym.nodes[edge.node_to]
+        if not src_node.states or not dst_node.states:
+            continue
+        src_instr = src_node.states[-1].get_current_instruction()
+        if src_instr["opcode"] not in ("JUMP", "JUMPI"):
+            continue  # SLOAD/SSTORE forks re-enter the same instruction
+        src_pc = src_instr["address"]
+        dst_pc = dst_node.states[0].get_current_instruction()["address"]
+        if src_pc >= analysis.code_len or dst_pc >= analysis.code_len:
+            continue  # creation-code nodes share the contract name
+        sb = analysis.block_at(src_pc)
+        db = analysis.block_at(dst_pc)
+        assert bool(analysis.succ_unknown[sb]) or db in analysis.successors(
+            sb
+        ), f"dynamic edge {src_pc}->{dst_pc} (block {sb}->{db}) missing"
+        checked += 1
+    assert checked > 0  # the run must actually exercise jumps
+
+
+# -- detection parity with the pass disabled ----------------------------------
+
+def _fire(name: str):
+    from mythril_tpu.analysis.module.util import reset_callback_modules
+    from mythril_tpu.analysis.security import fire_lasers
+
+    # module singletons accumulate across runs in one process; drain any
+    # leftovers from earlier tests so both measured runs start clean
+    reset_callback_modules()
+    issues = fire_lasers(_sym_exec(name))
+    return sorted((i.swc_id, i.address) for i in issues)
+
+
+def test_swc_findings_identical_with_pass_off(monkeypatch):
+    """The MUST-resolved jump fast path is a pure optimisation: findings
+    on a bench contract are identical when the static analysis is
+    unavailable (property returns None -> instructions.py falls back to
+    host concretization)."""
+    from mythril_tpu.disassembler import disassembly as dis_mod
+
+    with_pass = _fire("token")
+    monkeypatch.setattr(
+        dis_mod.Disassembly, "static_analysis", property(lambda self: None)
+    )
+    without_pass = _fire("token")
+    assert with_pass == without_pass
+    assert with_pass  # the corpus contract must actually yield findings
+
+
+# -- statically-resolved jumps never hit host concretization ------------------
+
+def test_resolved_jumps_skip_concretization(monkeypatch):
+    """bectoken's jumps are all PUSH-fed and MUST-resolved, so neither
+    jump_ nor jumpi_ may call util.get_concrete_int during the run."""
+    from mythril_tpu.laser.evm import instructions as instr_mod
+
+    real = instr_mod.util.get_concrete_int
+    offenders = []
+
+    def counting(value):
+        caller = sys._getframe(1).f_code.co_name
+        if caller in ("jump_", "jumpi_"):
+            offenders.append(caller)
+        return real(value)
+
+    monkeypatch.setattr(instr_mod.util, "get_concrete_int", counting)
+    sym = _sym_exec("bectoken")
+    assert sym.nodes  # the run explored something
+    assert offenders == []

@@ -1,0 +1,83 @@
+"""Device-side static revert pruning (laser/tpu/engine.py): JUMPI fork
+children whose taken target lands in a statically-proven must-revert-pure
+block are elided on outermost frames when the code bank is built with
+prune_revert=True, and the suppression is counted per lane."""
+
+from collections import Counter
+from pathlib import Path
+
+import numpy as np
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu.batch import (
+    REVERTED,
+    BatchConfig,
+    default_env,
+    empty_batch,
+    load_lane,
+    make_code_bank,
+)
+from mythril_tpu.laser.tpu.engine import run
+
+BENCH = Path(__file__).resolve().parent.parent.parent / "bench_contracts"
+
+CFG = BatchConfig(lanes=16, stack_slots=32, memory_bytes=1024,
+                  calldata_bytes=128, storage_slots=8, code_len=256)
+
+
+def _run_bectoken(prune: bool):
+    code = assemble((BENCH / "bectoken.asm").read_text())
+    cb = make_code_bank([code], CFG.code_len, prune_revert=prune)
+    st = empty_batch(CFG)
+    st = load_lane(st, 0, calldata=b"", gas=10_000_000, symbolic_calldata=True)
+    return run(cb, default_env(), st, max_steps=4096)
+
+
+def test_code_bank_carries_static_tables():
+    code = assemble((BENCH / "bectoken.asm").read_text())
+    cb = make_code_bank([code], CFG.code_len, prune_revert=True)
+    mrev = np.asarray(cb.must_revert)[0]
+    # exactly the shared `rev:` block (bytes 125..130) is must-revert-pure
+    assert np.nonzero(mrev)[0].tolist() == list(range(125, 131))
+    assert bool(np.asarray(cb.prune_revert))
+    cb_off = make_code_bank([code], CFG.code_len)
+    assert not bool(np.asarray(cb_off.prune_revert))
+    # the jumpdest bitmap comes from the verified static decode
+    jd = np.nonzero(np.asarray(cb_off.jumpdest)[0])[0].tolist()
+    assert jd == [18, 76, 114, 125]
+
+
+def test_prune_elides_exactly_the_reverting_forks():
+    base = _run_bectoken(prune=False)
+    pruned = _run_bectoken(prune=True)
+
+    base_alive = np.asarray(base.alive)
+    pruned_alive = np.asarray(pruned.alive)
+    base_statuses = np.asarray(base.status)[base_alive].tolist()
+    pruned_statuses = np.asarray(pruned.status)[pruned_alive].tolist()
+
+    n_reverted = base_statuses.count(REVERTED)
+    assert n_reverted > 0  # bectoken's require-guards must actually fire
+    # with pruning on, no lane terminates REVERTED...
+    assert pruned_statuses.count(REVERTED) == 0
+    # ...the surviving population is exactly the non-reverting lanes...
+    assert Counter(pruned_statuses) == Counter(
+        s for s in base_statuses if s != REVERTED
+    )
+    # ...and each suppressed fork was counted on the parent lane
+    assert int(np.asarray(pruned.static_pruned)[pruned_alive].sum()) == n_reverted
+    assert int(np.asarray(base.static_pruned)[base_alive].sum()) == 0
+
+
+def test_prune_respects_outermost_flag():
+    # inner-frame lanes (outermost=False) must fork normally even with
+    # prune_revert on: a nested revert is observable by the caller
+    code = assemble((BENCH / "bectoken.asm").read_text())
+    cb = make_code_bank([code], CFG.code_len, prune_revert=True)
+    st = empty_batch(CFG)
+    st = load_lane(st, 0, calldata=b"", gas=10_000_000, symbolic_calldata=True)
+    st = st._replace(outermost=st.outermost.at[0].set(False))
+    out = run(cb, default_env(), st, max_steps=4096)
+    statuses = np.asarray(out.status)[np.asarray(out.alive)].tolist()
+    assert statuses.count(REVERTED) > 0
+    assert int(np.asarray(out.static_pruned)[np.asarray(out.alive)].sum()) == 0
