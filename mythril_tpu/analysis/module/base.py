@@ -42,7 +42,12 @@ class DetectionModule(ABC):
 
     def __init__(self) -> None:
         self.issues: List[Issue] = []
-        self.cache: Set[int] = set()
+        # reported-site dedup keys: (contract name, byte address). The
+        # contract component is load-bearing for the multi-tenant
+        # analysis service: modules are process singletons, and a bare
+        # address would collide across concurrently running jobs (each
+        # job analyzes under a unique contract name)
+        self.cache: Set[tuple] = set()
 
     def reset_module(self):
         self.issues = []

@@ -161,7 +161,8 @@ class IntegerArithmetics(DetectionModule):
     # -- dispatch ----------------------------------------------------------
 
     def _execute(self, state) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
+        contract = state.environment.active_account.contract_name
+        if (contract, state.get_current_instruction()["address"]) in self.cache:
             return
         opcode = state.get_current_instruction()["opcode"]
         stack = state.mstate.stack
@@ -297,7 +298,9 @@ class IntegerArithmetics(DetectionModule):
         origin = hazard.origin_state
         kind = "Underflow" if hazard.operator == "subtraction" else "Overflow"
         address = origin.get_current_instruction()["address"]
-        self.cache.add(address)
+        self.cache.add(
+            (origin.environment.active_account.contract_name, address)
+        )
         self.issues.append(
             Issue(
                 contract=origin.environment.active_account.contract_name,

@@ -64,8 +64,9 @@ class UncheckedRetval(ProbeModule):
         instruction = state.get_current_instruction()
         trail = retval_trail(state)
         if instruction["opcode"] in ("STOP", "RETURN"):
+            contract = state.environment.active_account.contract_name
             for site, retval in trail.retvals:
-                if site in self.cache:
+                if (contract, site) in self.cache:
                     continue
                 yield Finding(address=site, constraints=[retval == 0])
             return

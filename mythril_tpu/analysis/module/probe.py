@@ -118,7 +118,8 @@ class ProbeModule(DetectionModule):
         return (address, tuple(uids))
 
     def _execute(self, state: GlobalState) -> None:
-        if self.site_address(state) in self.cache:
+        contract = state.environment.active_account.contract_name
+        if (contract, self.site_address(state)) in self.cache:
             return
         for finding in self.probe(state) or ():
             materialized = self._materialize(state, finding)
@@ -192,7 +193,7 @@ class ProbeModule(DetectionModule):
             transaction_sequence = solver.get_transaction_sequence(state, constraints)
         except UnsatError:
             return False
-        self.cache.add(address)
+        self.cache.add((common["contract"], address))
         self.issues.append(
             Issue(
                 transaction_sequence=transaction_sequence,

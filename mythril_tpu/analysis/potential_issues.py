@@ -116,7 +116,7 @@ class PotentialIssue:
 
     def promote(self, state: GlobalState, transaction_sequence) -> None:
         """Hand the finished Issue to the detector that parked this."""
-        self.detector.cache.add(self.address)
+        self.detector.cache.add((self.contract, self.address))
         self.detector.issues.append(
             Issue(
                 contract=self.contract,

@@ -38,3 +38,48 @@ def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issu
         collected.extend(module.execute(statespace) or [])
     collected.extend(retrieve_callback_issues(white_list))
     return collected
+
+
+def harvest_callback_issues(
+    contract_names, white_list: Optional[List[str]] = None
+) -> List[Issue]:
+    """Drain ONLY the issues attributed to ``contract_names`` from the
+    callback modules, leaving everything else in place.
+
+    The multi-tenant analysis service cannot use the reset-based drain
+    above: detection modules are process singletons, and a full
+    ``reset_callback_modules`` would wipe the accumulated findings (and
+    dedup caches) of every OTHER job still in flight. Each service job
+    runs under a unique contract name, so name-filtered removal splits
+    the singleton state exactly. The module's per-site dedup cache
+    entries for these contracts are dropped too — a finished job must
+    not leave keys behind in a long-lived process."""
+    names = set(contract_names)
+    collected: List[Issue] = []
+    for module in ModuleLoader().get_detection_modules(
+        entry_point=EntryPoint.CALLBACK, white_list=white_list
+    ):
+        keep: List[Issue] = []
+        for issue in module.issues:
+            (collected if issue.contract in names else keep).append(issue)
+        module.issues = keep
+        module.cache = {
+            key
+            for key in module.cache
+            if not (isinstance(key, tuple) and key and key[0] in names)
+        }
+    return collected
+
+
+def fire_lasers_for_job(
+    statespace, contract_names, white_list: Optional[List[str]] = None
+) -> List[Issue]:
+    """The service-side analogue of fire_lasers: POST modules over the
+    job's own statespace, then the name-filtered callback harvest."""
+    collected: List[Issue] = []
+    for module in ModuleLoader().get_detection_modules(
+        entry_point=EntryPoint.POST, white_list=white_list
+    ):
+        collected.extend(module.execute(statespace) or [])
+    collected.extend(harvest_callback_issues(contract_names, white_list))
+    return collected

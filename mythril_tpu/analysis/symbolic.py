@@ -95,14 +95,19 @@ class SymExecWrapper:
         custom_modules_directory: str = "",
         checkpoint_dir: Optional[str] = None,
         pre_exec_hook=None,
+        fresh_solver_core: bool = True,
     ):
         # every analysis starts from a fresh incremental solver core:
         # clause-database growth from prior contracts/runs in the same
         # process would slow budgeted feasibility checks unpredictably
-        # (order-dependent false negatives otherwise)
-        from mythril_tpu.smt.solver.incremental import reset_core
+        # (order-dependent false negatives otherwise). The multi-tenant
+        # analysis service opts OUT (fresh_solver_core=False): resetting
+        # here would drop the learned clauses of every other job in
+        # flight, and the service bounds core growth itself.
+        if fresh_solver_core:
+            from mythril_tpu.smt.solver.incremental import reset_core
 
-        reset_core()
+            reset_core()
 
         address = _as_address(address)
         requires_statespace = (

@@ -9,6 +9,8 @@ composed per command, and all output formatting funnels through one
 Subcommands:
   analyze (a)         symbolic-execution security analysis
   disassemble (d)     bytecode -> assembly listing
+  serve               multi-tenant analysis service (stdin-JSON / socket)
+  submit              submit bytecode to a running `myth serve` socket
   pro                 remote analysis through the MythX API
   list-detectors      registered detection modules
   version             package version
@@ -392,6 +394,96 @@ def run_truffle(args) -> None:
     _run_analysis(analyzer, args)
 
 
+def add_serve_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("service")
+    group.add_argument("--socket", metavar="PATH", help="serve over a Unix domain socket instead of stdin-JSON")
+    group.add_argument("--workers", type=int, default=2, help="concurrent analysis jobs (each may share device rounds)")
+    group.add_argument("--queue-size", type=int, default=16, help="bounded job queue; submissions beyond this are rejected")
+    group.add_argument("--gather-window", type=float, default=0.25, metavar="SEC", help="how long a device round waits to co-schedule other jobs' frontiers")
+    group.add_argument("--cache-entries", type=int, default=256, help="result-cache capacity (contracts)")
+    group.add_argument("--no-warm", action="store_true", help="skip the blocking device-kernel warmup at startup")
+    group.add_argument("--lanes", type=int, default=None, help="device lanes per shared round")
+
+
+def run_serve(args) -> None:
+    """The multi-tenant analysis service (docs/SERVICE.md): one process,
+    many submitted contracts, shared device rounds, cached results."""
+    import mythril_tpu.laser.tpu.backend as backend
+    from mythril_tpu.service import AnalysisService
+    from mythril_tpu.service.api import SocketServer, serve_stdio
+
+    if args.lanes:
+        backend.DEFAULT_BATCH_CFG = backend.DEFAULT_BATCH_CFG._replace(
+            lanes=args.lanes
+        )
+    service = AnalysisService(
+        workers=args.workers,
+        queue_size=args.queue_size,
+        gather_window_s=args.gather_window,
+        cache_entries=args.cache_entries,
+        warm=not args.no_warm,
+    )
+    try:
+        if args.socket:
+            server = SocketServer(service, args.socket)
+            print("serving on %s" % args.socket, file=sys.stderr)
+            server.serve_forever()
+        else:
+            serve_stdio(service, sys.stdin, sys.stdout)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.shutdown(wait=False)
+
+
+def add_submit_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("submission")
+    group.add_argument("--socket", metavar="PATH", required=True, help="socket of a running `myth serve --socket`")
+    group.add_argument("-c", "--code", help="hex-encoded creation bytecode string")
+    group.add_argument("-f", "--codefile", type=argparse.FileType("r"), help="file containing hex-encoded bytecode")
+    group.add_argument("--bin-runtime", action="store_true", help="treat the input as runtime bytecode")
+    group.add_argument("--name", default="contract", help="contract name used in the report")
+    group.add_argument("-t", "--transaction-count", type=int, default=2, help="transaction depth")
+    group.add_argument("--execution-timeout", type=int, default=60, metavar="SEC", help="per-job symbolic execution budget")
+    group.add_argument("-m", "--modules", metavar="MODULES", help="comma-separated detection module whitelist")
+    group.add_argument("--no-wait", action="store_true", help="print the job id and return without waiting for the result")
+
+
+def run_submit(args) -> None:
+    """Client for a running service: submit bytecode, print the result."""
+    from mythril_tpu.service.api import request_over_socket
+
+    code = args.code or ""
+    if args.codefile:
+        code = "".join(line.strip() for line in args.codefile if line.strip())
+    if not code:
+        raise CriticalError(
+            "No input bytecode. Provide EVM code via -c BYTECODE or -f BYTECODE_FILE"
+        )
+    request = {
+        "op": "submit",
+        "name": args.name,
+        "tx_count": args.transaction_count,
+        "timeout": args.execution_timeout,
+    }
+    if args.bin_runtime:
+        request["code"] = code
+    else:
+        request["creation_code"] = code
+    if args.modules:
+        request["modules"] = args.modules.split(",")
+    response = request_over_socket(args.socket, request, timeout=30)
+    if not response.get("ok"):
+        raise CriticalError("submission rejected: %s" % response.get("error"))
+    if args.no_wait:
+        print(json.dumps(response))
+        return
+    result = request_over_socket(
+        args.socket, {"op": "result", "job_id": response["job_id"]}
+    )
+    print(json.dumps(result, indent=2))
+
+
 # ------------------------------------------------------------------ registry
 
 COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
@@ -405,6 +497,16 @@ COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
         "Disassembles the input bytecode",
         [add_input_flags, add_rpc_flags, add_output_flag],
         run_disassemble,
+    ),
+    "serve": (
+        "Runs the multi-tenant analysis service",
+        [add_serve_flags],
+        run_serve,
+    ),
+    "submit": (
+        "Submits bytecode to a running analysis service",
+        [add_submit_flags],
+        run_submit,
     ),
     "pro": (
         "Analyzes input with the MythX API (https://mythx.io)",

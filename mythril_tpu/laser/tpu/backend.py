@@ -570,6 +570,7 @@ def _drain_ss_rings(bridge, st):
     ss_val = np.asarray(st.ss_val)
     ss_is_load = np.asarray(st.ss_is_load)
     ss_jd = np.asarray(st.ss_jd)
+    job_ids = np.asarray(st.job_id)
     spill_id = np.asarray(st.spill_id).copy()
     for lane in lanes:
         n = int(ss_cnt[lane])
@@ -584,6 +585,11 @@ def _drain_ss_rings(bridge, st):
             for j in range(n)
         ]
         spill_id[lane] = bridge.spill_chain(int(spill_id[lane]), events)
+        job = int(job_ids[lane])
+        if job:
+            bridge.ss_drains_by_job[job] = (
+                bridge.ss_drains_by_job.get(job, 0) + 1
+            )
     dev_mask = jnp.asarray(mask)
     return st._replace(
         status=jnp.where(dev_mask, _RUNNING, st.status),
@@ -891,7 +897,18 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
     prune_revert = not track_gas and not (
         laser.pre_hooks.get("REVERT") or laser.post_hooks.get("REVERT")
     )
-    seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
+    # multi-tenant seam: the analysis service installs a JobContext on
+    # the laser (service/lanes.py via SymExecWrapper's pre_exec_hook);
+    # when present, device rounds are shared with other in-flight jobs
+    # through the lane coordinator and this job's lanes are identified
+    # by the job_id plane
+    job_ctx = getattr(laser, "job_ctx", None)
+    if job_ctx is not None:
+        # fork headroom scales with the jobs sharing the lane axis
+        share = job_ctx.coordinator.active_jobs()
+        seed_cap = max(1, cfg.lanes // (2 * share))
+    else:
+        seed_cap = max(1, cfg.lanes // 2)  # leave headroom for device forks
     final_states: List[GlobalState] = []
     budget_deadline = (
         laser.time.timestamp() + laser.execution_timeout
@@ -909,6 +926,11 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             log.debug("Hit execution timeout in tpu-batch loop, returning.")
             # keep the in-flight frontier: the host loop's timeout path
             # returns the currently selected state too
+            return final_states + laser.work_list[:] if track_gas else None
+        if job_ctx is not None and job_ctx.cancelled():
+            # cancellation mirrors the deadline path: the in-flight
+            # frontier stays on the work list, never dropped
+            log.debug("job %d cancelled in tpu-batch loop", job_ctx.job_id)
             return final_states + laser.work_list[:] if track_gas else None
 
         # ---------------- phase A: one host instruction per state.
@@ -967,49 +989,80 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         overflow = survivors[seed_cap:]
         laser.work_list.extend(overflow)
 
-        bridge = DeviceBridge(
-            cfg,
-            host_ops=host_ops,
-            freeze_errors=True,
-            tape_replayers=replayers,
-            value_replayers=val_replayers,
-            prune_revert=prune_revert,
-        )
-        packed_states = []
-        for state in to_pack:
-            try:
-                bridge.stage(state)
-                packed_states.append(state)
-            except PackError as e:
-                log.debug("State stays on host path: %s", e)
-                laser.work_list.append(state)
-            except Exception as e:  # pragma: no cover - pack bugs degrade
-                # an unexpected staging failure must not kill the whole
-                # analysis: the state is untouched (stage wipes the lane
-                # on failure), so the host path continues it exactly
-                log.warning("pack failed unexpectedly (%s); host continues", e)
-                laser.work_list.append(state)
-        if not packed_states:
-            continue
+        if job_ctx is not None:
+            # shared round: this job's frontier rides the same device
+            # batch as every other gathered job's (service/lanes.py);
+            # ownership comes back on the job_id plane
+            res = job_ctx.coordinator.run_round(
+                job_id=job_ctx.job_id,
+                states=to_pack,
+                host_ops=host_ops,
+                tape_replayers=replayers,
+                value_replayers=val_replayers,
+                prune_revert=prune_revert,
+                deadline=budget_deadline,
+                cancel_event=job_ctx.cancel_event,
+            )
+            if res is None:
+                # cancelled while the round was pending: restore the
+                # in-flight states exactly like the deadline put-back —
+                # cancellation must not drop them
+                laser.work_list.extend(to_pack)
+                return final_states + laser.work_list[:] if track_gas else None
+            laser.work_list.extend(res.failed)
+            packed_states = res.packed
+            if res.out is None or not packed_states:
+                continue
+            bridge = res.bridge
+            out = res.out  # already host-side
+            op_hist = None
+            device_wall = res.device_wall
+            job_mask = np.asarray(out.job_id) == job_ctx.job_id
+        else:
+            bridge = DeviceBridge(
+                cfg,
+                host_ops=host_ops,
+                freeze_errors=True,
+                tape_replayers=replayers,
+                value_replayers=val_replayers,
+                prune_revert=prune_revert,
+            )
+            packed_states = []
+            for state in to_pack:
+                try:
+                    bridge.stage(state)
+                    packed_states.append(state)
+                except PackError as e:
+                    log.debug("State stays on host path: %s", e)
+                    laser.work_list.append(state)
+                except Exception as e:  # pragma: no cover - pack bugs degrade
+                    # an unexpected staging failure must not kill the whole
+                    # analysis: the state is untouched (stage wipes the lane
+                    # on failure), so the host path continues it exactly
+                    log.warning("pack failed unexpectedly (%s); host continues", e)
+                    laser.work_list.append(state)
+            if not packed_states:
+                continue
 
-        cb, st = bridge.finish()
-        round_start = time.time()
-        out, op_hist = _run_device(
-            cb,
-            st,
-            cfg,
-            want_stats=want_stats,
-            deadline=budget_deadline,
-            bridge=bridge,
-        )
-        # device wall captured NOW: _run_device's quiescence fetches have
-        # synced the final slice, and the download/dict-building below is
-        # host transport cost that must not inflate the device section
-        # (advisor r3)
-        device_wall = time.time() - round_start
-        # one download: everything below (step counters, coverage merge,
-        # per-lane unpack/lift) reads the host view for free
-        out = transfer.batch_to_host(out)
+            cb, st = bridge.finish()
+            round_start = time.time()
+            out, op_hist = _run_device(
+                cb,
+                st,
+                cfg,
+                want_stats=want_stats,
+                deadline=budget_deadline,
+                bridge=bridge,
+            )
+            # device wall captured NOW: _run_device's quiescence fetches
+            # have synced the final slice, and the download/dict-building
+            # below is host transport cost that must not inflate the
+            # device section (advisor r3)
+            device_wall = time.time() - round_start
+            # one download: everything below (step counters, coverage
+            # merge, per-lane unpack/lift) reads the host view for free
+            out = transfer.batch_to_host(out)
+            job_mask = None
         if op_hist is not None and laser.iprof is not None:
             hist = np.asarray(op_hist)
             counts = {
@@ -1024,10 +1077,23 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             if counts:
                 laser.iprof.record_device_round(counts, device_wall)
         strategy.device_rounds += 1
-        strategy.device_steps_retired += int(np.asarray(out.steps).sum())
-        strategy.ss_drains += bridge.ss_drain_count
+        # harvest split: in a shared round only the lanes stamped with
+        # THIS job's id feed its counters/coverage — other tenants'
+        # lanes (alive or dead) belong to their own accounting
+        own_alive = np.asarray(out.alive)
+        if job_mask is None:
+            strategy.device_steps_retired += int(np.asarray(out.steps).sum())
+            strategy.ss_drains += bridge.ss_drain_count
+        else:
+            own_alive = own_alive & job_mask
+            strategy.device_steps_retired += int(
+                np.asarray(out.steps)[job_mask].sum()
+            )
+            strategy.ss_drains += bridge.ss_drains_by_job.get(
+                job_ctx.job_id, 0
+            )
         strategy.static_pruned_lanes += int(
-            np.asarray(out.static_pruned)[np.asarray(out.alive)].sum()
+            np.asarray(out.static_pruned)[own_alive].sum()
         )
 
         # measurement parity: instructions retired on device feed the same
@@ -1035,9 +1101,8 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         if laser._device_coverage_hooks:
             visited = np.asarray(out.visited)
             code_ids = np.asarray(out.code_id)
-            alive_np = np.asarray(out.alive)
             for code_id, code_bytes in enumerate(bridge.codes):
-                lanes_mask = alive_np & (code_ids == code_id)
+                lanes_mask = own_alive & (code_ids == code_id)
                 if not lanes_mask.any():
                     continue
                 offsets = np.nonzero(visited[lanes_mask].any(axis=0))[0]
@@ -1046,7 +1111,6 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 for hook in laser._device_coverage_hooks:
                     hook(code_bytes.hex(), offsets.tolist())
 
-        alive = np.asarray(out.alive)
         status = np.asarray(out.status)
         resumed_states = []
         # deferred findings collected during hook replay park UNSCREENED
@@ -1057,8 +1121,8 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
 
         _pi.LAZY_SCREEN = True
         try:
-            for lane in range(cfg.lanes):
-                if not alive[lane]:
+            for lane in range(own_alive.shape[0]):
+                if not own_alive[lane]:
                     continue
                 if status[lane] == RUNNING:
                     # step budget exhausted mid-flight: unpack and
@@ -1082,7 +1146,7 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             _apply_loop_bound(laser, filter_feasible(resumed_states))
         )
         # device-born forks add to the explored-state count
-        laser.total_states += max(0, int(alive.sum()) - len(packed_states))
+        laser.total_states += max(0, int(own_alive.sum()) - len(packed_states))
     if strategy.device_rounds == 0 and not device_ready(cfg, want_stats):
         if _warmup_attempted(cfg, want_stats):
             log.info(

@@ -196,6 +196,10 @@ class StateBatch(NamedTuple):
     origin_sym: jnp.ndarray  # i32[L]
     balance_sym: jnp.ndarray  # i32[L]
     seed_id: jnp.ndarray  # i32[L] host-side id of the seeding state
+    # owning analysis job in a shared multi-tenant round (service/lanes.py);
+    # 0 = single-tenant / free lane. Fork children inherit it through the
+    # generic plane gather, so per-job harvest splits the batch exactly.
+    job_id: jnp.ndarray  # i32[L]
     # True when the lane's host state is an outermost (transaction-level)
     # frame — the gate for static must-revert pruning: a reverting
     # outermost frame is discarded by _finalize_transaction with no
@@ -286,6 +290,7 @@ def batch_shapes(cfg: BatchConfig) -> dict:
         "origin_sym": ((L,), np.int32),
         "balance_sym": ((L,), np.int32),
         "seed_id": ((L,), np.int32),
+        "job_id": ((L,), np.int32),
         "outermost": ((L,), np.bool_),
         "static_pruned": ((L,), np.int32),
     }
@@ -419,6 +424,7 @@ def _fill_lane(
     symbolic_callvalue: bool = False,
     symbolic_balance: bool = False,
     seed_id: int = 0,
+    job_id: int = 0,
     outermost: bool = True,
 ) -> None:
     C = np_batch["calldata"].shape[1]
@@ -471,6 +477,7 @@ def _fill_lane(
     np_batch["calldata_symbolic"][lane] = symbolic_calldata
     np_batch["storage_symbolic"][lane] = symbolic_storage
     np_batch["seed_id"][lane] = seed_id
+    np_batch["job_id"][lane] = job_id
     np_batch["outermost"][lane] = outermost
     np_batch["static_pruned"][lane] = 0
     from mythril_tpu.laser.tpu import symtape

@@ -140,10 +140,15 @@ class DeviceBridge:
         tape_replayers=None,
         value_replayers=None,
         prune_revert: bool = False,
+        job_id: int = 0,
     ):
         self.cfg = cfg
         self.host_ops = host_ops
         self.freeze_errors = freeze_errors
+        # owning analysis job for every lane this bridge packs (0 =
+        # single-tenant). Written into the job_id plane so a shared
+        # multi-tenant round can be split per job at harvest.
+        self.job_id = job_id
         # arm static must-revert fork pruning in the step kernel (the
         # backend only sets this when no REVERT hook is registered and
         # gas accounting is not being tracked — see exec_batch)
@@ -182,6 +187,9 @@ class DeviceBridge:
         self._ss_spill: Dict[int, tuple] = {}
         self._spill_next = 1
         self.ss_drain_count = 0
+        # per-job drain attribution for shared multi-tenant rounds
+        # (filled by backend._drain_ss_rings from the job_id plane)
+        self.ss_drains_by_job: Dict[int, int] = {}
 
     # ------------------------------------------------------------------
     # storage-ring spill
@@ -309,6 +317,7 @@ class DeviceBridge:
         np_batch["pc"][lane] = pc_byte
         np_batch["code_id"][lane] = code_id
         np_batch["seed_id"][lane] = seed_id
+        np_batch["job_id"][lane] = self.job_id
         # outermost = transaction-level frame (no caller state): the only
         # frames static must-revert pruning may kill at fork time
         np_batch["outermost"][lane] = (

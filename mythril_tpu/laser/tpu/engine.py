@@ -1180,6 +1180,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         origin_sym=st.origin_sym,
         balance_sym=st.balance_sym,
         seed_id=st.seed_id,
+        job_id=st.job_id,
         outermost=st.outermost,
         # count each suppressed child on the lane that would have forked
         # it — the path-tape append still commits (the fall-through keeps

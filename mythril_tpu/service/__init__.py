@@ -1,0 +1,49 @@
+"""Multi-tenant analysis service.
+
+The first subsystem above the single-analysis boundary: a persistent
+in-process service that keeps the device batch saturated across JOBS the
+way inference servers amortize compilation and batch slack across
+requests. Four parts:
+
+  scheduler.py  AnalysisService — admission control, a bounded job queue
+                with backpressure, worker threads, per-job deadlines and
+                cancellation.
+  lanes.py      LaneCoordinator — multiplexes the device-bound frontiers
+                of several in-flight jobs into ONE SoA StateBatch round;
+                every lane carries the owning job in the ``job_id``
+                plane, and harvest splits per job on that plane.
+  cache.py      ResultCache — completed reports and static-pass tables
+                keyed by keccak(creation_code ‖ runtime_code), so a
+                repeated submission of an already-analyzed contract is
+                answered without re-execution.
+  api.py        stdin-JSON / local-socket front end (submit / status /
+                result / cancel / stats) behind ``myth serve`` and
+                ``myth submit``.
+
+See docs/SERVICE.md for scheduler states, the lane-sharing invariants,
+and the cache key definition.
+"""
+
+from mythril_tpu.service.api import handle_request
+from mythril_tpu.service.cache import ResultCache, cache_key
+from mythril_tpu.service.lanes import JobContext, LaneCoordinator
+from mythril_tpu.service.scheduler import (
+    AdmissionError,
+    AnalysisJob,
+    AnalysisService,
+    JobState,
+    QueueFullError,
+)
+
+__all__ = [
+    "AdmissionError",
+    "AnalysisJob",
+    "AnalysisService",
+    "JobContext",
+    "JobState",
+    "LaneCoordinator",
+    "QueueFullError",
+    "ResultCache",
+    "cache_key",
+    "handle_request",
+]

@@ -1,0 +1,164 @@
+"""Front end for the analysis service: line-delimited JSON requests.
+
+One request protocol serves both transports:
+
+  * stdin-JSON: ``myth serve`` with no ``--socket`` reads one JSON
+    request per line from stdin and writes one JSON response per line
+    to stdout — trivially scriptable and the shape the tests drive
+  * local socket: ``myth serve --socket PATH`` binds a Unix domain
+    socket; each connection carries the same line-delimited exchange.
+    ``myth submit`` is the matching client
+
+Request shape: ``{"op": <name>, ...params}``. Responses always carry
+``{"ok": true/false, ...}``; a false ``ok`` carries ``"error"`` (and
+``"kind"`` distinguishing admission rejects from backpressure so
+clients know whether to retry). See docs/SERVICE.md for the op table.
+"""
+
+import json
+import logging
+import os
+import socket
+import threading
+from typing import Dict, Optional
+
+from mythril_tpu.service.scheduler import (
+    AdmissionError,
+    AnalysisService,
+    QueueFullError,
+)
+
+log = logging.getLogger(__name__)
+
+
+def handle_request(service: AnalysisService, request: Dict) -> Dict:
+    """Dispatch one decoded request against the service; never raises."""
+    try:
+        op = request.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "submit":
+            job_id = service.submit(
+                runtime_hex=request.get("code", ""),
+                creation_hex=request.get("creation_code", ""),
+                tx_count=int(request.get("tx_count", 2)),
+                timeout=request.get("timeout", 60),
+                modules=request.get("modules"),
+                name=str(request.get("name", "contract")),
+                max_depth=int(request.get("max_depth", 128)),
+            )
+            return {"ok": True, "job_id": job_id}
+        if op == "status":
+            return {"ok": True, **service.status(int(request["job_id"]))}
+        if op == "result":
+            job_id = int(request["job_id"])
+            service.wait(job_id, timeout=request.get("timeout"))
+            status = service.status(job_id)
+            return {
+                "ok": True,
+                **status,
+                "result": service.result(job_id),
+            }
+        if op == "cancel":
+            return {"ok": True, "cancelled": service.cancel(int(request["job_id"]))}
+        if op == "stats":
+            return {"ok": True, **service.stats()}
+        if op == "shutdown":
+            return {"ok": True, "shutdown": True}
+        return {"ok": False, "kind": "bad-request", "error": "unknown op %r" % op}
+    except QueueFullError as e:
+        return {"ok": False, "kind": "backpressure", "error": str(e)}
+    except AdmissionError as e:
+        return {"ok": False, "kind": "admission", "error": str(e)}
+    except (KeyError, TypeError, ValueError) as e:
+        return {"ok": False, "kind": "bad-request", "error": str(e)}
+    except Exception as e:  # pragma: no cover - defensive
+        log.exception("request failed")
+        return {"ok": False, "kind": "internal", "error": str(e)}
+
+
+def serve_stdio(service: AnalysisService, infile, outfile) -> None:
+    """One JSON request per input line, one JSON response per output
+    line. Returns after EOF or an explicit shutdown op."""
+    for line in infile:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            request = json.loads(line)
+        except json.JSONDecodeError as e:
+            response = {"ok": False, "kind": "bad-request", "error": str(e)}
+        else:
+            response = handle_request(service, request)
+        outfile.write(json.dumps(response) + "\n")
+        outfile.flush()
+        if response.get("shutdown"):
+            return
+
+
+class SocketServer:
+    """Line-delimited JSON over a Unix domain socket."""
+
+    def __init__(self, service: AnalysisService, path: str):
+        self.service = service
+        self.path = path
+        if os.path.exists(path):
+            os.unlink(path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(8)
+        self._sock.settimeout(0.5)
+        self._stop = threading.Event()
+
+    def serve_forever(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(
+                    target=self._serve_connection, args=(conn,), daemon=True
+                ).start()
+        finally:
+            self._sock.close()
+            if os.path.exists(self.path):
+                os.unlink(self.path)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        with conn, conn.makefile("rw", encoding="utf-8") as stream:
+            for line in stream:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    request = json.loads(line)
+                except json.JSONDecodeError as e:
+                    response = {"ok": False, "kind": "bad-request", "error": str(e)}
+                else:
+                    response = handle_request(self.service, request)
+                stream.write(json.dumps(response) + "\n")
+                stream.flush()
+                if response.get("shutdown"):
+                    self.stop()
+                    return
+
+
+def request_over_socket(
+    path: str, request: Dict, timeout: Optional[float] = None
+) -> Dict:
+    """Client half: send one request to a serving socket, return the
+    decoded response (``myth submit`` uses this)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+        sock.settimeout(timeout)
+        sock.connect(path)
+        with sock.makefile("rw", encoding="utf-8") as stream:
+            stream.write(json.dumps(request) + "\n")
+            stream.flush()
+            line = stream.readline()
+    if not line:
+        raise ConnectionError("service closed the connection without a response")
+    return json.loads(line)

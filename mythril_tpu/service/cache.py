@@ -1,0 +1,144 @@
+"""Result & artifact cache keyed by keccak of the submitted code.
+
+A service sees the same contracts again and again (zkEVM pipelines make
+the same observation about per-contract artifacts — PAPERS.md,
+"Constraint-Level Design of zkEVMs"): the report for a given
+(code, analysis parameters) pair is deterministic, so re-running the
+analysis buys nothing. The key is ``keccak256(creation_code ‖ runtime
+code)`` — the exact bytes that seed execution — and an entry only
+answers a lookup whose analysis parameters (transaction count, module
+whitelist, execution timeout) match the ones it was computed under: a
+longer budget or a wider module set can legitimately find MORE issues,
+so parameter-mismatched entries must not be returned.
+
+Three artifact classes ride in an entry:
+
+  * the finished issue report (list of ``Issue.as_dict`` dicts + SWC set)
+  * the static-pass tables (``analysis.static_pass.StaticAnalysis``,
+    held as ``(code bytes, tables)`` pairs) — already cached
+    process-wide by code bytes, but that cache is a bounded LRU; the
+    entry holds a strong reference and re-seeds the pass cache on hit
+    so a popular contract never re-pays the pass
+  * warm jit specializations need no storage at all: every job in the
+    service shares one process and one BatchConfig, so the XLA
+    executable compiled for the first job IS the warm specialization
+    every later job runs (backend._warmup_done + jax's jit cache)
+"""
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from mythril_tpu.support.keccak import keccak256
+
+
+def cache_key(creation_hex: str, runtime_hex: str) -> bytes:
+    """keccak256 over the exact submitted code bytes."""
+    creation = bytes.fromhex(creation_hex or "")
+    runtime = bytes.fromhex(runtime_hex or "")
+    return keccak256(creation + runtime)
+
+
+def _normalize_params(
+    tx_count: int, modules: Optional[List[str]], timeout: Optional[float]
+) -> Tuple:
+    mods = tuple(sorted(modules)) if modules else None
+    return (int(tx_count), mods, timeout)
+
+
+class CacheEntry:
+    def __init__(
+        self,
+        params: Tuple,
+        issues: List[Dict[str, Any]],
+        swc_ids: List[str],
+        cold_wall_s: float,
+        static_tables=None,
+    ):
+        self.params = params
+        self.issues = issues
+        self.swc_ids = swc_ids
+        self.cold_wall_s = cold_wall_s
+        # [(code bytes, StaticAnalysis)] for every bytecode the job ran
+        self.static_tables = static_tables or []
+        self.created_at = time.time()
+        self.hits = 0
+
+
+class ResultCache:
+    """Bounded LRU over completed analyses; thread-safe."""
+
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, CacheEntry]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(
+        self,
+        key: bytes,
+        tx_count: int,
+        modules: Optional[List[str]] = None,
+        timeout: Optional[float] = None,
+    ) -> Optional[CacheEntry]:
+        params = _normalize_params(tx_count, modules, timeout)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.params != params:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            entry.hits += 1
+            self.hits += 1
+        if entry.static_tables:
+            self._reseed_static_pass(entry.static_tables)
+        return entry
+
+    def put(
+        self,
+        key: bytes,
+        tx_count: int,
+        modules: Optional[List[str]],
+        timeout: Optional[float],
+        issues: List[Dict[str, Any]],
+        swc_ids: List[str],
+        cold_wall_s: float,
+        static_tables=None,
+    ) -> CacheEntry:
+        entry = CacheEntry(
+            _normalize_params(tx_count, modules, timeout),
+            issues,
+            swc_ids,
+            cold_wall_s,
+            static_tables=static_tables,
+        )
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        return entry
+
+    @staticmethod
+    def _reseed_static_pass(tables) -> None:
+        """Re-insert the held static-pass tables into the pass's own LRU
+        so a hit on a long-evicted contract restores them for free."""
+        from mythril_tpu.analysis import static_pass
+
+        for code, analysis in tables:
+            static_pass._CACHE[bytes(code)] = analysis
+            static_pass._CACHE.move_to_end(bytes(code))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

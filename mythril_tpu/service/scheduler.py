@@ -1,0 +1,430 @@
+"""Job scheduler for the multi-tenant analysis service.
+
+``AnalysisService`` owns the whole service runtime: admission control
+over submitted jobs, a bounded queue with backpressure, a small pool of
+worker threads, per-job deadlines and cancellation, the shared-lane
+coordinator (lanes.py) and the result cache (cache.py).
+
+Job lifecycle (docs/SERVICE.md):
+
+    submit() -> QUEUED -> RUNNING -> DONE | FAILED | CANCELLED
+
+  * submit() rejects malformed input (AdmissionError) and applies
+    backpressure when the queue is full (QueueFullError) — callers
+    retry or shed load; the service never buffers unboundedly
+  * a cache hit at submission completes the job as DONE immediately
+    (cache_hit=True) without ever entering the queue
+  * cancel() flips the job's cancel event: a QUEUED job completes as
+    CANCELLED without running; a RUNNING job is stopped at the next
+    host-loop / batch-loop check with its in-flight states put back
+    (laser/tpu/backend.py, laser/evm/svm.py)
+
+Concurrency model: every worker runs ONE job's full analysis pipeline
+(SymExecWrapper -> detection harvest) under the service-wide HOST lock.
+The lock is released only while the job waits in / runs a shared device
+round (lanes.py invariant I3) — that window is what lets several jobs'
+host phases interleave and their frontiers share one device batch. All
+the process-global singletons the pipeline touches (incremental solver
+core, detection-module issue lists, the keccak function manager) are
+therefore never entered concurrently (invariant I2).
+
+Jobs execute under a unique internal contract name (``<name>#<id>``) so
+the singleton detection modules' findings and dedup caches split
+exactly per job at harvest (analysis/security.py
+harvest_callback_issues); the user-facing name is restored on the
+reported issues afterwards, which keeps repeated submissions
+byte-identical with their cached reports.
+"""
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from enum import Enum
+from typing import Dict, List, Optional
+
+from mythril_tpu.service.cache import ResultCache, cache_key
+from mythril_tpu.service.lanes import (
+    DEFAULT_GATHER_WINDOW_S,
+    JobContext,
+    LaneCoordinator,
+)
+
+log = logging.getLogger(__name__)
+
+# analysis contract address, same placeholder the CLI bytecode path uses
+JOB_ADDRESS = 0x1234
+
+# hard ceiling on submitted code (creation + runtime): far above EIP-170
+# but low enough that a malformed submission cannot balloon the packer
+MAX_CODE_BYTES = 1 << 20
+
+
+class AdmissionError(ValueError):
+    """The submission is malformed and will never be accepted."""
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the job queue is at capacity; retry later."""
+
+
+class JobState(Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+class AnalysisJob:
+    """One submitted analysis: code + parameters + lifecycle state."""
+
+    def __init__(
+        self,
+        job_id: int,
+        name: str,
+        runtime_hex: str,
+        creation_hex: str,
+        tx_count: int,
+        timeout: Optional[float],
+        modules: Optional[List[str]],
+        max_depth: int,
+    ):
+        self.id = job_id
+        self.name = name
+        self.runtime_hex = runtime_hex
+        self.creation_hex = creation_hex
+        self.tx_count = tx_count
+        self.timeout = timeout
+        self.modules = modules
+        self.max_depth = max_depth
+        self.key = cache_key(creation_hex, runtime_hex)
+        self.state = JobState.QUEUED
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.wall_s: Optional[float] = None
+        self.cache_hit = False
+        self.result: Optional[Dict] = None
+        self.error: Optional[str] = None
+        self.cancel_event = threading.Event()
+        self.done_event = threading.Event()
+
+    @property
+    def internal_name(self) -> str:
+        """Contract name the job executes under — unique per job so the
+        singleton detection modules' state splits exactly at harvest."""
+        return "%s#%d" % (self.name, self.id)
+
+    def finish(self, state: JobState) -> None:
+        self.state = state
+        self.finished_at = time.time()
+        if self.started_at is not None:
+            self.wall_s = self.finished_at - self.started_at
+        self.done_event.set()
+
+    def status_dict(self) -> Dict:
+        return {
+            "job_id": self.id,
+            "name": self.name,
+            "state": self.state.value,
+            "cache_hit": self.cache_hit,
+            "wall_s": self.wall_s,
+            "error": self.error,
+        }
+
+
+def _clean_hex(value: Optional[str], what: str) -> str:
+    value = (value or "").strip()
+    if value.startswith(("0x", "0X")):
+        value = value[2:]
+    if len(value) % 2 != 0:
+        raise AdmissionError("%s: odd-length hex" % what)
+    try:
+        bytes.fromhex(value)
+    except ValueError:
+        raise AdmissionError("%s: invalid hex" % what)
+    return value
+
+
+class AnalysisService:
+    """The persistent in-process analysis service."""
+
+    def __init__(
+        self,
+        workers: int = 2,
+        queue_size: int = 16,
+        batch_cfg=None,
+        gather_window_s: float = DEFAULT_GATHER_WINDOW_S,
+        cache_entries: int = 256,
+        warm: bool = False,
+    ):
+        if batch_cfg is None:
+            from mythril_tpu.laser.tpu import backend
+
+            batch_cfg = backend.DEFAULT_BATCH_CFG
+        self.batch_cfg = batch_cfg
+        # ONE lock serializes every job's host-phase Python (invariant
+        # I2); acquired exactly once per scope so the coordinator can
+        # release it while a job parks in a device round (I3)
+        self.host_lock = threading.RLock()
+        self.coordinator = LaneCoordinator(
+            batch_cfg, self.host_lock, gather_window_s=gather_window_s
+        )
+        self.cache = ResultCache(max_entries=cache_entries)
+        self.queue_size = queue_size
+        self._queue: "deque[AnalysisJob]" = deque()
+        self._queue_cv = threading.Condition(threading.Lock())
+        self._jobs: Dict[int, AnalysisJob] = {}
+        self._ids = itertools.count(1)  # 0 marks a free lane (batch.py)
+        self._shutdown = False
+        self.jobs_submitted = 0
+        self.jobs_done = 0
+        self.jobs_failed = 0
+        self.jobs_cancelled = 0
+        self._workers = [
+            threading.Thread(
+                target=self._worker, name="analysis-worker-%d" % i, daemon=True
+            )
+            for i in range(max(1, workers))
+        ]
+        for thread in self._workers:
+            thread.start()
+        if warm:
+            # compile the shared device kernels up front so the first
+            # job does not serialize every tenant behind the XLA compile
+            from mythril_tpu.laser.tpu import backend
+
+            backend.warmup_device(batch_cfg)
+
+    # ------------------------------------------------------------- frontend
+
+    def submit(
+        self,
+        runtime_hex: str,
+        creation_hex: Optional[str] = None,
+        tx_count: int = 2,
+        timeout: Optional[float] = 60,
+        modules: Optional[List[str]] = None,
+        name: str = "contract",
+        max_depth: int = 128,
+    ) -> int:
+        """Admit a job; returns its id. Raises AdmissionError on
+        malformed input, QueueFullError under backpressure."""
+        if self._shutdown:
+            raise RuntimeError("service is shut down")
+        runtime_hex = _clean_hex(runtime_hex, "runtime code")
+        creation_hex = _clean_hex(creation_hex, "creation code")
+        if not runtime_hex and not creation_hex:
+            raise AdmissionError("empty submission: no code to analyze")
+        if (len(runtime_hex) + len(creation_hex)) // 2 > MAX_CODE_BYTES:
+            raise AdmissionError("submitted code exceeds %d bytes" % MAX_CODE_BYTES)
+        if tx_count < 1:
+            raise AdmissionError("tx_count must be >= 1")
+        if timeout is not None and timeout <= 0:
+            raise AdmissionError("timeout must be positive")
+
+        job = AnalysisJob(
+            next(self._ids), name, runtime_hex, creation_hex,
+            tx_count, timeout, modules, max_depth,
+        )
+        self._jobs[job.id] = job
+        self.jobs_submitted += 1
+
+        entry = self.cache.get(job.key, tx_count, modules, timeout)
+        if entry is not None:
+            job.started_at = time.time()
+            job.cache_hit = True
+            job.result = {
+                "issues": entry.issues,
+                "swc_ids": entry.swc_ids,
+                "cache_hit": True,
+                "cold_wall_s": entry.cold_wall_s,
+            }
+            job.finish(JobState.DONE)
+            self.jobs_done += 1
+            return job.id
+
+        with self._queue_cv:
+            if len(self._queue) >= self.queue_size:
+                del self._jobs[job.id]
+                self.jobs_submitted -= 1
+                raise QueueFullError(
+                    "queue full (%d jobs); retry later" % self.queue_size
+                )
+            self._queue.append(job)
+            self._queue_cv.notify()
+        return job.id
+
+    def status(self, job_id: int) -> Dict:
+        return self._job(job_id).status_dict()
+
+    def result(self, job_id: int, wait: bool = False,
+               timeout: Optional[float] = None) -> Optional[Dict]:
+        job = self._job(job_id)
+        if wait:
+            job.done_event.wait(timeout)
+        return job.result
+
+    def wait(self, job_id: int, timeout: Optional[float] = None) -> bool:
+        return self._job(job_id).done_event.wait(timeout)
+
+    def cancel(self, job_id: int) -> bool:
+        """Request cancellation; returns True if the job had not already
+        finished. Queued jobs complete as CANCELLED without running;
+        running jobs stop at the engine's next cancellation check with
+        their in-flight states put back (never dropped)."""
+        job = self._job(job_id)
+        if job.done_event.is_set():
+            return False
+        job.cancel_event.set()
+        with self._queue_cv:
+            self._queue_cv.notify_all()
+        return True
+
+    def stats(self) -> Dict:
+        return {
+            "jobs_submitted": self.jobs_submitted,
+            "jobs_done": self.jobs_done,
+            "jobs_failed": self.jobs_failed,
+            "jobs_cancelled": self.jobs_cancelled,
+            "queued": len(self._queue),
+            "rounds": self.coordinator.rounds,
+            "shared_rounds": self.coordinator.shared_rounds,
+            "max_resident_jobs": self.coordinator.max_resident_jobs,
+            "cache": self.cache.stats(),
+        }
+
+    def shutdown(self, wait: bool = True, timeout: Optional[float] = 30) -> None:
+        self._shutdown = True
+        with self._queue_cv:
+            self._queue_cv.notify_all()
+        if wait:
+            for thread in self._workers:
+                thread.join(timeout)
+
+    # -------------------------------------------------------------- workers
+
+    def _job(self, job_id: int) -> AnalysisJob:
+        job = self._jobs.get(job_id)
+        if job is None:
+            raise KeyError("unknown job id %r" % job_id)
+        return job
+
+    def _next_job(self) -> Optional[AnalysisJob]:
+        with self._queue_cv:
+            while True:
+                while self._queue:
+                    job = self._queue.popleft()
+                    if job.cancel_event.is_set():
+                        job.finish(JobState.CANCELLED)
+                        self.jobs_cancelled += 1
+                        continue
+                    return job
+                if self._shutdown:
+                    return None
+                self._queue_cv.wait(timeout=0.2)
+
+    def _worker(self) -> None:
+        while True:
+            job = self._next_job()
+            if job is None:
+                return
+            try:
+                self._run_job(job)
+            except BaseException:  # pragma: no cover - worker survives
+                log.exception("worker crashed on job %d", job.id)
+                if not job.done_event.is_set():
+                    job.error = "internal worker failure"
+                    job.finish(JobState.FAILED)
+                    self.jobs_failed += 1
+
+    def _run_job(self, job: AnalysisJob) -> None:
+        from mythril_tpu.analysis.security import fire_lasers_for_job
+        from mythril_tpu.analysis.symbolic import SymExecWrapper
+        from mythril_tpu.ethereum.evmcontract import EVMContract
+
+        job.state = JobState.RUNNING
+        job.started_at = time.time()
+        ctx = JobContext(job.id, self.coordinator, job.cancel_event)
+        self.coordinator.job_started()
+        issues = []
+        error: Optional[str] = None
+        try:
+            contract = EVMContract(
+                code=job.runtime_hex,
+                creation_code=job.creation_hex,
+                name=job.internal_name,
+            )
+            with self.host_lock:
+                sym = SymExecWrapper(
+                    contract,
+                    address=JOB_ADDRESS,
+                    strategy="tpu-batch",
+                    execution_timeout=(
+                        int(job.timeout) if job.timeout else None
+                    ),
+                    transaction_count=job.tx_count,
+                    max_depth=job.max_depth,
+                    modules=job.modules,
+                    pre_exec_hook=ctx.install,
+                    fresh_solver_core=False,
+                )
+                issues = fire_lasers_for_job(
+                    sym, {job.internal_name}, job.modules
+                )
+        except Exception as e:
+            log.warning("job %d failed: %s", job.id, e)
+            error = str(e)
+        finally:
+            self.coordinator.job_finished()
+
+        if job.cancel_event.is_set():
+            job.finish(JobState.CANCELLED)
+            self.jobs_cancelled += 1
+            return
+        if error is not None:
+            job.error = error
+            job.finish(JobState.FAILED)
+            self.jobs_failed += 1
+            return
+
+        # the user asked about <name>, not the internal tenancy name
+        for issue in issues:
+            issue.contract = job.name
+        issue_dicts = [issue.as_dict for issue in issues]
+        swc_ids = sorted({issue.swc_id for issue in issues})
+        job.result = {
+            "issues": issue_dicts,
+            "swc_ids": swc_ids,
+            "cache_hit": False,
+        }
+        job.finish(JobState.DONE)
+        self.jobs_done += 1
+        self.cache.put(
+            job.key,
+            job.tx_count,
+            job.modules,
+            job.timeout,
+            issue_dicts,
+            swc_ids,
+            cold_wall_s=job.wall_s or 0.0,
+            static_tables=self._static_tables(job),
+        )
+
+    @staticmethod
+    def _static_tables(job: AnalysisJob) -> list:
+        """(code, tables) pairs for the entry's artifact side; analyze()
+        is memoized so this only reads the pass's own cache."""
+        from mythril_tpu.analysis import static_pass
+
+        tables = []
+        for code_hex in (job.runtime_hex, job.creation_hex):
+            if code_hex:
+                code = bytes.fromhex(code_hex)
+                try:
+                    tables.append((code, static_pass.analyze(code)))
+                except Exception:  # noqa: artifact side is best-effort
+                    pass
+        return tables

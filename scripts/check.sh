@@ -29,4 +29,13 @@ python -m pytest tests/analysis/test_static_pass.py \
     tests/analysis/test_disassembler_truncated.py \
     -q -p no:cacheprovider -k "golden or cache or push or scan"
 
+echo "== service fast tests =="
+# scheduler/cache/api lifecycle with the pipeline stubbed out — no
+# symbolic execution; the real multi-tenant integration runs in
+# tests/service/test_multitenant.py with the full suite
+python -m pytest tests/service/test_cache.py \
+    tests/service/test_scheduler.py \
+    tests/service/test_api.py \
+    -q -p no:cacheprovider
+
 echo "ALL CHECKS PASSED"

@@ -17,6 +17,12 @@ standard library can check reliably:
   - no swallowed exceptions (a catch-all handler — bare ``except:``,
     ``except Exception``/``BaseException`` — whose body is only
     ``pass``/``...`` hides real failures; noqa exempts)
+  - no direct StateBatch lane indexing outside the lanes/bridge layer
+    (``x.tape_op[...]``, ``x.job_id[...]`` etc. in product code must go
+    through ``service/lanes.py`` / ``laser/tpu/bridge.py`` — reaching
+    into another job's lanes breaks the multi-tenant isolation
+    invariants in docs/SERVICE.md; the tpu kernel modules that OWN the
+    planes and tests are exempt, as are noqa'd lines)
   - no tabs in indentation, no trailing whitespace, newline at EOF
 
 Run via scripts/check.sh. Exit 0 = clean.
@@ -310,6 +316,59 @@ def swallowed_exceptions(tree: ast.AST, source: str):
     return sorted(set(out))
 
 
+# Plane names distinctive enough that `<expr>.<plane>[...]` can only be a
+# StateBatch lane access (generic names like pc/alive/status/memory would
+# false-positive on unrelated objects, so they are deliberately absent —
+# the distinctive planes appear in every realistic access cluster).
+_LANE_PLANES = {
+    "tape_op", "tape_a", "tape_b", "tape_imm", "tape_meta", "tape_len",
+    "path_id", "path_sign", "path_meta", "path_len",
+    "stack_sym", "msym_off", "msym_id", "msym_used",
+    "skey_sym", "sval_sym",
+    "ss_pc", "ss_key", "ss_val", "ss_is_load", "ss_jd", "ss_cnt",
+    "jd_ring", "jd_cnt", "storage_used", "seed_id", "job_id",
+    "static_pruned",
+}
+
+# Modules allowed to index lanes directly: the tpu kernel/bridge layer
+# that OWNS the planes, and the shared-lane coordinator.
+_LANE_INDEX_ALLOWED = {
+    "mythril_tpu/laser/tpu/batch.py",
+    "mythril_tpu/laser/tpu/engine.py",
+    "mythril_tpu/laser/tpu/symtape.py",
+    "mythril_tpu/laser/tpu/bridge.py",
+    "mythril_tpu/laser/tpu/transfer.py",
+    "mythril_tpu/laser/tpu/mesh.py",
+    "mythril_tpu/laser/tpu/backend.py",
+    "mythril_tpu/service/lanes.py",
+}
+
+
+def lane_indexing(tree: ast.AST, source: str, rel: str):
+    """(lineno, desc) pairs for ``<expr>.<plane>[...]`` subscripts in
+    product code outside the lanes/bridge layer. Per-job lane ownership
+    (docs/SERVICE.md invariant I1) is only enforceable if every lane
+    access funnels through the owning modules; tests are exempt (they
+    assert ON the planes), and noqa exempts a deliberate exception."""
+    if not rel.startswith("mythril_tpu/") or rel in _LANE_INDEX_ALLOWED:
+        return []
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr in _LANE_PLANES
+            and not _noqa(lines, node.lineno)
+        ):
+            out.append((
+                node.lineno,
+                "direct StateBatch lane indexing "
+                f"('.{node.value.attr}[...]') outside lanes.py/bridge.py",
+            ))
+    return sorted(set(out))
+
+
 def main() -> int:
     problems = []
     n_files = 0
@@ -331,6 +390,8 @@ def main() -> int:
         for lineno, desc in mutable_defaults(tree, source):
             problems.append(f"{rel}:{lineno}: {desc}")
         for lineno, desc in swallowed_exceptions(tree, source):
+            problems.append(f"{rel}:{lineno}: {desc}")
+        for lineno, desc in lane_indexing(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")

@@ -1,0 +1,92 @@
+"""Device-solver fallback: undecided is NOT infeasible.
+
+Regression pin for the frontier feasibility triage
+(laser/tpu/backend.py filter_feasible): when the batched device solver
+cannot decide an instance — CNF blasting exceeds the kernel caps
+(solver_jax.CapExceeded -> verdict None), the search budget runs out, or
+the dispatch itself fails — the lane must fall through to the host Z3
+path, never be treated as infeasible. Dropping undecided-but-satisfiable
+states would silently truncate exploration (missed detections), which is
+exactly the failure mode these tests make loud.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+import mythril_tpu.laser.tpu.backend as backend
+from mythril_tpu.laser.evm.state.constraints import Constraints
+from mythril_tpu.laser.tpu import solver_jax
+from mythril_tpu.smt import symbol_factory
+
+
+def _state(*constraints):
+    """A stand-in GlobalState: filter_feasible only reads
+    world_state.constraints."""
+    cs = Constraints()
+    for constraint in constraints:
+        cs.append(constraint)
+    return SimpleNamespace(world_state=SimpleNamespace(constraints=cs))
+
+
+def _frontier():
+    """One satisfiable and one unsatisfiable state (host-decidable)."""
+    x = symbol_factory.BitVecSym("fallback_x", 256)
+    one = symbol_factory.BitVecVal(1, 256)
+    two = symbol_factory.BitVecVal(2, 256)
+    return _state(x == one), _state(x == one, x == two)
+
+
+@pytest.fixture
+def device_engaged(monkeypatch):
+    """Force the device-solve dispatch path regardless of warmup state
+    or frontier size."""
+    monkeypatch.setattr(backend, "_warmup_done", True)
+    monkeypatch.setattr(backend, "MIN_DEVICE_SOLVE_BATCH", 1)
+
+
+def test_cap_exceeded_blast_returns_undecided(monkeypatch):
+    # an instance too large for the kernel shapes must come back None
+    # (check on host), not False (infeasible)
+    monkeypatch.setattr(solver_jax, "MAX_VARS", 4)
+    x = symbol_factory.BitVecSym("fallback_cap_x", 256)
+    verdicts = solver_jax.feasibility_batch(
+        [[(x == symbol_factory.BitVecVal(1, 256)).raw]]
+    )
+    assert verdicts == [None]
+
+
+def test_undecided_verdicts_fall_back_to_host(monkeypatch, device_engaged):
+    sat, unsat = _frontier()
+    monkeypatch.setattr(
+        solver_jax, "feasibility_batch", lambda sets, **kw: [None] * len(sets)
+    )
+    survivors = backend.filter_feasible([sat, unsat])
+    # the host solver decided both: the satisfiable lane survives
+    assert survivors == [sat]
+    assert sat.world_state.constraints._is_possible is True
+    assert unsat.world_state.constraints._is_possible is False
+
+
+def test_dispatch_failure_falls_back_to_host(monkeypatch, device_engaged):
+    sat, unsat = _frontier()
+
+    def boom(sets, **kw):
+        raise solver_jax.CapExceeded("clauses")
+
+    monkeypatch.setattr(solver_jax, "feasibility_batch", boom)
+    survivors = backend.filter_feasible([sat, unsat])
+    assert survivors == [sat]
+
+
+def test_device_verdicts_are_seeded_when_decided(monkeypatch, device_engaged):
+    # sanity check of the counterpart path: decided verdicts seed the
+    # constraints without a host solve
+    sat, unsat = _frontier()
+    monkeypatch.setattr(
+        solver_jax, "feasibility_batch", lambda sets, **kw: [True, False]
+    )
+    survivors = backend.filter_feasible([sat, unsat])
+    assert survivors == [sat]
+    # seeded, not host-solved: _is_possible was set directly
+    assert unsat.world_state.constraints._is_possible is False

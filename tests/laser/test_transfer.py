@@ -95,6 +95,52 @@ def test_roundtrip_monomorphic():
         transfer._MONO.clear()
 
 
+def test_packed_frontier_roundtrip_property():
+    """Property test over random REAL frontiers: states packed through
+    DeviceBridge.pack_into (concrete and symbolic calldata lanes mixed)
+    must survive batch_to_device ∘ batch_to_host bit-exactly on every
+    plane the download carries (_SKIP_DOWN planes are rebuilt as zeros).
+
+    The random-plane round-trips above pin the byte layout; this pins
+    the integration with the packer — the planes a real GlobalState
+    produces (sliced tapes, sparse storage, partial calldata, the
+    multi-tenant job_id plane) take the data-dependent upload paths."""
+    from mythril_tpu.laser.tpu.batch import batch_shapes
+    from mythril_tpu.laser.tpu.bridge import DeviceBridge
+
+    from tests.laser.test_bridge import BRANCH_STORE_SRC, CFG, deploy, message_state
+
+    laser, ws, account = deploy(BRANCH_STORE_SRC)
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        bridge = DeviceBridge(CFG, job_id=int(rng.integers(1, 9)))
+        n_states = int(rng.integers(2, CFG.lanes // 2 + 1))
+        staged = 0
+        for _ in range(n_states):
+            if rng.integers(0, 2):
+                calldata = bytes(
+                    rng.integers(0, 256, int(rng.integers(0, 68)), dtype=np.uint8)
+                )
+                gs = message_state(ws, account, calldata=calldata)
+            else:
+                gs = message_state(ws, account)  # symbolic calldata lane
+            bridge.stage(gs)
+            staged += 1
+        cb, st = bridge.finish()
+        back = transfer.batch_to_host(st)
+        for name in batch_shapes(CFG):
+            staged_plane = bridge._np_batch[name]
+            down = np.asarray(getattr(back, name))
+            if name in transfer._SKIP_DOWN:
+                assert not np.any(down), name
+            else:
+                assert np.array_equal(down, staged_plane), (seed, name)
+        # the job-id plane tags exactly the staged lanes
+        job_ids = np.asarray(back.job_id)
+        assert (job_ids[:staged] == bridge.job_id).all()
+        assert (job_ids[staged:] == 0).all()
+
+
 def test_monomorphic_env_override(monkeypatch):
     # bench harnesses pin one variant per direction via env regardless
     # of backend; 0 forces the polymorphic path likewise

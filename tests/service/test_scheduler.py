@@ -1,0 +1,135 @@
+"""AnalysisService scheduler: admission, backpressure, cancellation,
+cache-hit fast path. The analysis pipeline itself is stubbed — these
+tests pin the job lifecycle, not symbolic execution (that's
+tests/service/test_multitenant.py)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from mythril_tpu.service import (
+    AdmissionError,
+    AnalysisService,
+    JobState,
+    QueueFullError,
+)
+from mythril_tpu.service.cache import cache_key
+
+# the scheduler only threads batch_cfg through to the coordinator; a
+# stand-in avoids importing the device backend in lifecycle tests
+DUMMY_CFG = SimpleNamespace(lanes=8)
+
+
+class StubbedService(AnalysisService):
+    """Workers run a controllable stub instead of the real pipeline."""
+
+    def __init__(self, **kw):
+        self.release = threading.Event()
+        self.ran = []
+        super().__init__(batch_cfg=DUMMY_CFG, **kw)
+
+    def _run_job(self, job):
+        job.state = JobState.RUNNING
+        job.started_at = time.time()
+        self.release.wait(timeout=30)
+        self.ran.append(job.id)
+        job.result = {"issues": [], "swc_ids": [], "cache_hit": False}
+        job.finish(JobState.DONE)
+        self.jobs_done += 1
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+@pytest.fixture
+def service():
+    svc = StubbedService(workers=1, queue_size=2)
+    yield svc
+    svc.release.set()
+    svc.shutdown(wait=True, timeout=10)
+
+
+def test_admission_rejects_malformed_input(service):
+    with pytest.raises(AdmissionError):
+        service.submit("zz")  # not hex
+    with pytest.raises(AdmissionError):
+        service.submit("600")  # odd length
+    with pytest.raises(AdmissionError):
+        service.submit("", "")  # no code at all
+    with pytest.raises(AdmissionError):
+        service.submit("6000", tx_count=0)
+    with pytest.raises(AdmissionError):
+        service.submit("6000", timeout=-1)
+    with pytest.raises(AdmissionError):
+        service.submit("00" * (2 << 20))  # over the size cap
+    # a rejected submission leaves no job behind
+    assert service.jobs_submitted == 0
+
+
+def test_hex_prefix_normalization(service):
+    job_id = service.submit("0x6000")
+    assert service.status(job_id)["state"] in ("queued", "running")
+
+
+def test_backpressure_bounded_queue(service):
+    # worker 1 holds job A; B and C fill the queue of 2; D must bounce
+    ids = [service.submit("6000")]
+    assert wait_for(lambda: service.status(ids[0])["state"] == "running")
+    ids += [service.submit("60%02x" % n) for n in (1, 2)]
+    with pytest.raises(QueueFullError):
+        service.submit("60ff")
+    # backpressure is retryable: draining the queue re-admits
+    service.release.set()
+    assert all(service.wait(i, timeout=10) for i in ids)
+    job_id = service.submit("60ff")
+    assert service.wait(job_id, timeout=10)
+
+
+def test_cancel_queued_job_never_runs(service):
+    blocker = service.submit("6001")
+    assert wait_for(lambda: service.status(blocker)["state"] == "running")
+    queued = service.submit("6002")
+    assert service.cancel(queued)
+    service.release.set()
+    assert service.wait(queued, timeout=10)
+    assert service.status(queued)["state"] == "cancelled"
+    assert queued not in service.ran  # the stub never saw it
+    # cancelling a finished job is a no-op
+    assert service.wait(blocker, timeout=10)
+    assert not service.cancel(blocker)
+
+
+def test_cache_hit_completes_at_submission(service):
+    runtime = "6003"
+    key = cache_key("", runtime)
+    service.cache.put(
+        key, 2, None, 60, [{"swc-id": "106", "contract": "C"}], ["106"],
+        cold_wall_s=12.5,
+    )
+    t0 = time.time()
+    job_id = service.submit(runtime, tx_count=2, timeout=60, name="C")
+    assert time.time() - t0 < 1.0
+    status = service.status(job_id)
+    assert status["state"] == "done" and status["cache_hit"]
+    result = service.result(job_id)
+    assert result["swc_ids"] == ["106"] and result["cache_hit"]
+    # parameter mismatch is NOT a hit: tx_count differs -> runs fresh
+    miss_id = service.submit(runtime, tx_count=3, timeout=60, name="C")
+    assert not service.status(miss_id)["cache_hit"]
+
+
+def test_stats_shape(service):
+    stats = service.stats()
+    for field in (
+        "jobs_submitted", "jobs_done", "queued",
+        "rounds", "shared_rounds", "max_resident_jobs", "cache",
+    ):
+        assert field in stats
