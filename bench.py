@@ -252,6 +252,29 @@ def _ratio(num, den):
     return round(num / den, 2)
 
 
+def _solver_snapshot() -> dict:
+    """Current process-global solver-cache counters (bench protocol:
+    solver_time_s / solver_cache_hit_rate / z3_fallback_inflight_p95)."""
+    from mythril_tpu.laser.tpu import solver_cache
+
+    return solver_cache.GLOBAL.snapshot()
+
+
+def _solver_delta(base: dict) -> dict:
+    """Solver-seam fields for one measured phase, as deltas against the
+    phase-entry snapshot (the cache is process-global)."""
+    now = _solver_snapshot()
+    queries = now["queries"] - base["queries"]
+    hits = now["hits"] - base["hits"]
+    return {
+        "solver_time_s": round(now["time_s"] - base["time_s"], 4),
+        "solver_cache_hit_rate": round(hits / queries, 4) if queries else 0.0,
+        "solver_cache_hits": hits,
+        "solver_queries": queries,
+        "z3_fallback_inflight_p95": now["inflight_p95"],
+    }
+
+
 def _emit(progress: dict) -> None:
     host_rate = progress.get("host_states_per_sec")
     bec_host = progress.get("bectoken_host_states_per_sec")
@@ -282,6 +305,13 @@ def _emit(progress: dict) -> None:
                 else round(bec_rate, 1),
                 "bectoken_vs_host": _ratio(bec_rate, bec_host),
                 "bectoken_swcs": progress.get("bectoken_swcs"),
+                "solver_time_s": progress.get("solver_time_s"),
+                "solver_cache_hit_rate": progress.get("solver_cache_hit_rate"),
+                "solver_cache_hits": progress.get("solver_cache_hits"),
+                "solver_queries": progress.get("solver_queries"),
+                "z3_fallback_inflight_p95": progress.get(
+                    "z3_fallback_inflight_p95"
+                ),
                 "static_pass_s": progress.get("static_pass_s"),
                 "static_pruned_lanes": progress.get("static_pruned_lanes"),
                 "integrated_static_pruned_lanes": progress.get(
@@ -527,12 +557,14 @@ def main() -> int:
     _checkpoint(progress)
 
     _phase("integrated tpu-batch pipeline (stress contract, tx=2 budget=60)")
+    solver_base = _solver_snapshot()
     meter, integrated_swcs, integrated_pruned = _steady_analysis(
         creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
     )
     progress["integrated_states_per_sec"] = meter.states_per_s
     progress["integrated_swcs"] = integrated_swcs
     progress["integrated_static_pruned_lanes"] = integrated_pruned
+    progress.update(_solver_delta(solver_base))
     _checkpoint(progress)
 
     # the BASELINE.md north-star workload: the faithful BECToken
@@ -561,11 +593,13 @@ def main() -> int:
     progress["bectoken_host_states_per_sec"] = bec_host_meter.states_per_s
     _checkpoint(progress)
     _phase("integrated tpu-batch pipeline (BECToken, tx=3 budget=120)")
+    bec_solver_base = _solver_snapshot()
     bec_meter, bec_swcs, bec_pruned = _steady_analysis(
         bec_creation, bec_runtime.hex(), "tpu-batch", 3, 120, "BECToken"
     )
     progress["bectoken_states_per_sec"] = bec_meter.states_per_s
     progress["bectoken_swcs"] = bec_swcs
+    progress["bectoken_solver"] = _solver_delta(bec_solver_base)
     # cost/benefit of the static pre-analysis pass: its cumulative wall
     # time across every analysis in this process, and the device fork
     # children it pruned on the north-star BECToken row

@@ -43,7 +43,7 @@ from mythril_tpu.laser.tpu.batch import (
 from mythril_tpu.laser.evm.plugins.signals import PluginSkipState
 from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
 from mythril_tpu.laser.tpu.engine import run, run_with_stats
-from mythril_tpu.laser.tpu import solver_jax, symtape, transfer
+from mythril_tpu.laser.tpu import solver_cache, solver_jax, symtape, transfer
 from mythril_tpu.support.opcodes import OPCODES
 
 log = logging.getLogger(__name__)
@@ -122,6 +122,10 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # destination enters a static must-revert block (engine.py
         # prune_child; bench protocol field static_pruned_lanes)
         self.static_pruned_lanes = 0
+        # solver-cache accounting baseline: the cache is process-global
+        # (verdicts legitimately outlive one analysis), so per-analysis
+        # counters are deltas against the construction-time snapshot
+        self._solver_base = solver_cache.GLOBAL.snapshot()
         # start compiling the device kernels NOW on a background thread:
         # the creation transaction and the first host rounds overlap the
         # XLA compile, and exec_batch switches to device rounds the
@@ -129,6 +133,30 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # whole CLI behind a compile that can take minutes on a slow
         # machine — or forever on a wedged accelerator tunnel.
         warmup_device_async(self.batch_cfg)
+
+    def solver_stats(self) -> dict:
+        """This analysis's solver-seam accounting (deltas against the
+        construction-time snapshot of the process-global cache):
+        solver_cache_hits / solver_cache_hit_rate / solver_time_s /
+        z3_fallback_inflight_p95 — the bench protocol fields."""
+        now = solver_cache.GLOBAL.snapshot()
+        base = self._solver_base
+        queries = now["queries"] - base["queries"]
+        hits = now["hits"] - base["hits"]
+        return {
+            "solver_cache_hits": hits,
+            "solver_cache_hit_rate": (hits / queries) if queries else 0.0,
+            "solver_time_s": now["time_s"] - base["time_s"],
+            "z3_fallback_inflight_p95": now["inflight_p95"],
+        }
+
+    @property
+    def solver_cache_hits(self) -> int:
+        return self.solver_stats()["solver_cache_hits"]
+
+    @property
+    def solver_time_s(self) -> float:
+        return self.solver_stats()["solver_time_s"]
 
     def engaged(self) -> bool:
         """The scheduler's time gate: ONE definition shared by svm.exec
@@ -364,6 +392,17 @@ def value_replayers_for(laser) -> dict:
 # window to sub-8 feasibility batches before this floor
 MIN_DEVICE_SOLVE_BATCH = 8
 
+# search-flip budget per feasibility dispatch (static jit argnum: one
+# budget = one kernel compile, so every call site must agree with the
+# warmup). Deliberately SMALL: the round loop treats device SAT and
+# UNKNOWN identically (the lane survives either way; settlement
+# re-solves authoritatively), so all pruning throughput comes from the
+# decision-free phase-1 propagation — phase-2 flips only buy SAT
+# witnesses for warm-start model propagation, and r6 measured the 384-
+# flip budget spending >60% of round wall time on unknown-heavy
+# frontiers (BECStress) for verdicts the loop ignores
+SOLVE_FLIPS = 64
+
 # device-phase step budget per exec_batch round
 DEVICE_STEP_BUDGET = 4096
 
@@ -493,7 +532,17 @@ def _do_warmup(key, event) -> None:
         from mythril_tpu.smt import terms as _terms
 
         warm_formula = [_terms.bool_eq(_terms.bv_var("!warmup", 8), _terms.bv_const(1, 8))]
-        solver_jax.check_batch([warm_formula] * MIN_DEVICE_SOLVE_BATCH)
+        # warm the EXACT specializations the hot loop dispatches: the
+        # feasibility flip budget (SOLVE_FLIPS — flips is a static
+        # argnum, so a different budget is a different compile) at both
+        # batch-ladder steps (remainder chunks and full chunks)
+        solver_cache.warm_device(
+            [warm_formula] * MIN_DEVICE_SOLVE_BATCH, flips=SOLVE_FLIPS
+        )
+        if not transfer.monomorphic():
+            solver_cache.warm_device(
+                [warm_formula] * solver_jax.MAX_BATCH, flips=SOLVE_FLIPS
+            )
         _warmup_done.add(key)
     except Exception as e:  # pragma: no cover - warmup is best-effort
         log.warning("device warmup failed (analysis stays on host): %s", e)
@@ -674,10 +723,17 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
 
 
 def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
-    """Frontier-wide feasibility: decide every undecided path condition in
-    one batched device solve (unit propagation + ordered-DPLL search,
-    laser/tpu/solver_jax.py), seed the sound verdicts, and let the host
-    incremental CDCL pick up only the instances the device left open.
+    """Frontier-wide feasibility: consult the solver cache (verdict
+    memo, UNSAT-prefix subsumption — laser/tpu/solver_cache.py), decide
+    the misses in one batched device solve (unit propagation +
+    ordered-DPLL search, laser/tpu/solver_jax.py, warm-started from
+    parent-path models), and let whatever stays UNKNOWN proceed
+    optimistically (unknown counts as possible — identical to
+    Constraints.is_possible semantics; settlement re-solves
+    authoritatively, and in service mode the async fallback pool's late
+    UNSAT prunes the lane's descendants via subsumption next round).
+    When the device did not run, an inline quick host check on the
+    incremental CDCL prunes the frontier instead.
 
     Replaces the reference's one-Z3-call-per-forked-state pattern
     (mythril/laser/ethereum/svm.py:254, state/constraints.py:41).
@@ -689,21 +745,22 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
     undecided = [
         s for s in states if s.world_state.constraints._is_possible is None
     ]
-    if _warmup_done and len(undecided) >= MIN_DEVICE_SOLVE_BATCH:
+    if undecided:
+        # modest search budget: this is triage — propagation decides the
+        # common selector/guard conditions instantly, and anything the
+        # budget leaves open survives the round as possible
+        use_device = bool(_warmup_done) and len(undecided) >= MIN_DEVICE_SOLVE_BATCH
         sets = [
             [c.raw for c in s.world_state.constraints] for s in undecided
         ]
-        try:
-            # modest search budget: this is triage — propagation decides the
-            # common selector/guard conditions instantly, and anything the
-            # budget leaves open goes to the warm host CDCL
-            verdicts = solver_jax.feasibility_batch(sets, flips=384)
-        except Exception as e:  # pragma: no cover - device issues degrade
-            log.warning("device feasibility batch failed: %s", e)
-            verdicts = [None] * len(undecided)
+        hints = [getattr(s, "_solver_prefix_fps", None) for s in undecided]
+        verdicts = solver_cache.GLOBAL.decide_batch(
+            sets, use_device=use_device, flips=SOLVE_FLIPS, hints=hints
+        )
         for s, verdict in zip(undecided, verdicts):
-            if verdict is not None:
-                s.world_state.constraints.seed_feasibility(verdict)
+            s.world_state.constraints.seed_feasibility(
+                True if verdict is None else verdict
+            )
     return [s for s in states if s.world_state.constraints.is_possible]
 
 
@@ -787,7 +844,12 @@ def _triage_lazy_screens(states: List[GlobalState]) -> None:
     try:
         sets = [[c.raw for c in issue.constraints] for _, issue in reps]
         sets += [[c.raw for c in cons] for _, _, cons in prescreen]
-        verdicts = solver_jax.feasibility_batch(sets, flips=384)
+        # host_fallback=False: unknown parks go to settlement, not to a
+        # host solve — but memoized verdicts from the frontier path and
+        # earlier rounds short-circuit here for free
+        verdicts = solver_cache.GLOBAL.decide_batch(
+            sets, use_device=True, flips=SOLVE_FLIPS, host_fallback=False
+        )
     except Exception as e:  # pragma: no cover - device issues degrade
         log.warning("lazy screen triage failed: %s", e)
         return

@@ -1052,6 +1052,20 @@ class DeviceBridge:
         for cond in self.lane_constraints(st, lane, values, side):
             gs.world_state.constraints.append(cond)
 
+        # stable fork-time fingerprints of the device path prefix:
+        # siblings share the parent tape, so shared prefixes hash
+        # identically — the solver cache keys warm-start models by
+        # these (laser/tpu/solver_cache.py; hint-only, never a verdict)
+        plen = int(np.asarray(st.path_len)[lane])
+        if plen:
+            ids = np.asarray(st.path_id)[lane, :plen]
+            if (ids > 0).all():
+                h1 = np.asarray(st.tape_h1)[lane][ids - 1]
+                h2 = np.asarray(st.tape_h2)[lane][ids - 1]
+                signs = np.asarray(st.path_sign)[lane, :plen]
+                fps = symtape.path_fingerprint(h1, h2, signs)
+                gs._solver_prefix_fps = tuple(int(f) for f in fps)
+
         self._replay_jumpi_sites(gs, st, lane, values)
         self._replay_segment_sites(gs, st, lane, values)
         return gs

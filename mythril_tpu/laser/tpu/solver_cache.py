@@ -1,0 +1,866 @@
+"""Solver acceleration layer: constraint dedup between the engine and the
+solvers (docs/SOLVER.md).
+
+Forked sibling lanes share long constraint prefixes, so the frontier's
+feasibility queries are dominated by near-duplicates — the classic
+incrementality observation of modern SMT engines. This module sits
+between the round loop (laser/tpu/backend.filter_feasible) and the two
+actual deciders (the batched device kernel in solver_jax and the host
+incremental CDCL core) and removes redundant solves three ways:
+
+  1. verdict memoization — every decided constraint set is recorded
+     under two keys: the exact key (frozenset of hash-consed term uids;
+     structural equality IS identity, so this can never false-hit) and
+     an alpha-canonical key (order-insensitive, variable-renaming-
+     normalized digest) so the same shape re-queried next round, next
+     transaction, or next job resubmission is answered from the table.
+     UNKNOWN verdicts are memoized too: re-solving a set that already
+     exhausted the device budget AND the host quick budget is pure
+     waste (measured: BECToken's deep instances return 100% unknown).
+  2. prefix subsumption — a superset of an already-UNSAT set is UNSAT
+     without any solve (monotonicity of conjunction). Children extend
+     their parent's constraint list append-only, so a late UNSAT
+     verdict (e.g. from the async pool) prunes the whole descendant
+     subtree on the next round. SAT never transfers to supersets; SAT
+     entries are only reused on exact or alpha-equal keys.
+  3. warm-started device solves — a SAT verdict's named-symbol model is
+     cached under the lane's path-prefix fingerprint (symtape
+     .path_fingerprint, attached at lift time by the bridge); children
+     pass the nearest ancestor model down to the WalkSAT kernel as a
+     decision-phase hint. Hints affect performance only, never
+     soundness (solver_jax verifies every SAT witness).
+
+Whatever stays UNKNOWN after the device dispatch and the inline quick
+host check goes to a bounded ASYNC fallback pool of host CDCL workers
+(one private IncrementalCore per worker thread — the process-global
+core is not safe for concurrent entry). The round loop proceeds
+optimistically (unknown counts as possible, exactly the semantics of
+Constraints.is_possible); pool results fold back into the memo table
+where subsumption turns them into prunes. Pool entries carry the
+owning job's deadline and cancel event (service/scheduler.py): a
+cancelled or expired job's pending queries are dropped at dequeue
+time, never solved.
+
+The alpha key is structure-only (stable across processes), so the
+multi-tenant service exports/imports it per code hash
+(service/cache.ResultCache.{get,put}_solver_memo) and resubmissions of
+a popular contract start with a warm verdict table. Exact keys are
+uid-based and never exported: uids are process-local.
+"""
+
+import hashlib
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.solver import pysat
+from mythril_tpu.smt.solver.bitblast import BlastError
+from mythril_tpu.smt.solver.incremental import IncrementalCore, get_core
+from mythril_tpu.smt.terms import Term
+
+log = logging.getLogger(__name__)
+
+SAT = pysat.SAT
+UNSAT = pysat.UNSAT
+UNKNOWN = pysat.UNKNOWN
+
+# inline quick host check budget: mirrors Constraints.FEASIBILITY_BUDGET_MS
+# (the cost this layer replaces), NOT imported to avoid a laser.evm dep.
+HOST_BUDGET_MS = 100
+# async pool: per-query budget is deliberately larger than the inline
+# budget — the pool exists to resolve exactly the instances the quick
+# budget could not, off the round loop's critical path.
+FALLBACK_TIMEOUT_MS = 4000
+FALLBACK_WORKERS = 2
+FALLBACK_QUEUE_MAX = 128
+
+# alpha-canonicalization is linear in the constraint DAG, but a frontier
+# of pathological lanes should not burn host time hashing; past this many
+# nodes a set is memoized by exact uid key only.
+ALPHA_NODE_CAP = 20_000
+
+_NAMED_OPS = ("var", "boolvar", "array_var", "apply")
+
+_U64 = (1 << 64) - 1
+
+
+def _mix64(h: int, v: int) -> int:
+    """One round of a splitmix-style 64-bit mix."""
+    h = ((h ^ (v & _U64)) * 0xBF58476D1CE4E5B9) & _U64
+    return h ^ (h >> 29)
+
+
+# ---------------------------------------------------------------------------
+# canonical (alpha) fingerprints
+# ---------------------------------------------------------------------------
+
+# uid -> blind hash. uids are monotonic and never reused (terms._mk), so a
+# bounded LRU can only false-miss, never false-hit.
+_blind_memo: "OrderedDict[int, int]" = OrderedDict()
+_BLIND_MEMO_MAX = 1 << 16
+_blind_lock = threading.Lock()
+
+
+def _op_tag(t: Term) -> Tuple:
+    """The node's identity with symbol names blanked: alpha-equivalent
+    terms get identical tags. Non-name params (array domains, extract
+    bounds, constants) stay — they are structure, not naming."""
+    if t.op in _NAMED_OPS:
+        return (t.op, t.sort, t.size) + tuple(t.params[1:])
+    return (t.op, t.sort, t.size) + tuple(t.params)
+
+
+def _blind_hash(root: Term) -> int:
+    """Bottom-up 64-bit hash of a term with variable names blanked
+    (iterative over the DAG; memoized process-wide by uid)."""
+    with _blind_lock:
+        cached = _blind_memo.get(root.uid)
+    if cached is not None:
+        return cached
+    stack = [(root, False)]
+    local: Dict[int, int] = {}
+    while stack:
+        t, expanded = stack.pop()
+        if t.uid in local:
+            continue
+        with _blind_lock:
+            hit = _blind_memo.get(t.uid)
+        if hit is not None:
+            local[t.uid] = hit
+            continue
+        if not expanded:
+            stack.append((t, True))
+            stack.extend((a, False) for a in t.args)
+            continue
+        h = _mix64(0x9E3779B97F4A7C15, hash(_op_tag(t)))
+        for a in t.args:
+            h = _mix64(h, local[a.uid])
+        local[t.uid] = h
+        with _blind_lock:
+            _blind_memo[t.uid] = h
+            while len(_blind_memo) > _BLIND_MEMO_MAX:
+                _blind_memo.popitem(last=False)
+    return local[root.uid]
+
+
+def _collect_nodes(roots: Sequence[Term], cap: int) -> Optional[List[Term]]:
+    """Reverse-topological node list of the forest (parents before a
+    node only after the node — i.e. post-order de-duplicated); None if
+    the DAG exceeds ``cap`` nodes."""
+    out: List[Term] = []
+    seen = set()
+    stack = [(t, False) for t in roots]
+    while stack:
+        t, expanded = stack.pop()
+        if expanded:
+            out.append(t)
+            continue
+        if t.uid in seen:
+            continue
+        seen.add(t.uid)
+        if len(seen) > cap:
+            return None
+        stack.append((t, True))
+        stack.extend((a, False) for a in t.args)
+    return out
+
+
+def canonical_fingerprint(raw_terms: Sequence[Term]) -> Optional[bytes]:
+    """Order-insensitive, rename-insensitive digest of a constraint set.
+
+    Two sets with the same digest are literally equal up to a renaming
+    of their free symbols (the final step re-serializes every term with
+    canonical variable indices, so a digest collision between
+    non-alpha-equivalent sets would require a hash collision) — and
+    alpha-equivalent sets share satisfiability, so verdicts transfer.
+
+    Canonical variable indices come from sorting symbols on a blind
+    occurrence-context signature (one Weisfeiler-Leman-style round:
+    bottom-up blind hash + top-down folded ancestor context).
+    Symmetric variables can tie — ties are broken by traversal order,
+    which may differ between renamings of a symmetric set, costing a
+    cache MISS, never a wrong hit.
+
+    Returns None when the set is too large to canonicalize cheaply.
+    """
+    roots = []
+    seen_roots = set()
+    for t in raw_terms:
+        if t is terms.TRUE:
+            continue
+        if t.uid not in seen_roots:
+            seen_roots.add(t.uid)
+            roots.append(t)
+    roots.sort(key=lambda t: t.uid)
+    nodes = _collect_nodes(roots, ALPHA_NODE_CAP)
+    if nodes is None:
+        return None
+
+    # top-down folded ancestor context: ctx(node) = sum over parent
+    # edges of mix(ctx(parent), parent tag, arg position). Roots seed
+    # with their blind hash (identical across renamings). Processing in
+    # reverse post-order guarantees parents are finished first.
+    ctx: Dict[int, int] = {}
+    for r in roots:
+        bh = _blind_hash(r)
+        ctx[r.uid] = (ctx.get(r.uid, 0) + bh) & _U64
+    for t in reversed(nodes):
+        base = ctx.get(t.uid, 0)
+        if not t.args:
+            continue
+        tag = hash(_op_tag(t))
+        for i, a in enumerate(t.args):
+            edge = _mix64(_mix64(base, tag), i)
+            ctx[a.uid] = (ctx.get(a.uid, 0) + edge) & _U64
+
+    # canonical index per named symbol, ordered by (signature, kind)
+    named = [t for t in nodes if t.op in _NAMED_OPS]
+    named.sort(key=lambda t: (ctx.get(t.uid, 0), _op_tag(t)))
+    index = {t.uid: i for i, t in enumerate(named)}
+
+    # final serialization with names replaced by canonical indices;
+    # per-node digests memoized per call (linear over the DAG)
+    digests: Dict[int, bytes] = {}
+    for t in nodes:
+        h = hashlib.blake2b(digest_size=16)
+        if t.op in _NAMED_OPS:
+            h.update(repr((t.op, t.sort, t.size, index[t.uid]) + tuple(t.params[1:])).encode())
+        else:
+            h.update(repr(_op_tag(t)).encode())
+        for a in t.args:
+            h.update(digests[a.uid])
+        digests[t.uid] = h.digest()
+
+    final = hashlib.blake2b(digest_size=16)
+    for d in sorted(digests[r.uid] for r in roots):
+        final.update(d)
+    return final.digest()
+
+
+# ---------------------------------------------------------------------------
+# host checks
+# ---------------------------------------------------------------------------
+
+
+def _host_check(
+    raw_terms: Sequence[Term],
+    timeout_ms: int,
+    core: Optional[IncrementalCore] = None,
+) -> int:
+    """One budgeted host CDCL feasibility check over raw terms.
+
+    ``core=None`` uses the process-global incremental core (single-
+    threaded callers only: service invariant I2). Pool workers pass
+    their private per-thread core."""
+    if any(t is terms.FALSE for t in raw_terms):
+        return UNSAT
+    concrete = [t for t in raw_terms if t is not terms.TRUE]
+    if not concrete:
+        return SAT
+    if core is None:
+        core = get_core()
+    else:
+        core._maybe_recycle()
+    lits: List[int] = []
+    rws: List[Term] = []
+    try:
+        for t in concrete:
+            lit, rw = core.lower(t)
+            lits.append(lit)
+            rws.append(rw)
+    except BlastError:
+        return UNKNOWN
+    return core.solve_checked(lits, rws, timeout_ms=timeout_ms)
+
+
+# ---------------------------------------------------------------------------
+# per-job context (set by the service scheduler around job execution)
+# ---------------------------------------------------------------------------
+
+_JOB_CTX = threading.local()
+
+
+def set_job_context(deadline: Optional[float] = None, cancel_event=None) -> None:
+    """Tag this thread's subsequent fallback submissions with the owning
+    job's deadline (absolute time.time()) and cancel event, so the pool
+    can drop them when the job dies (satellite: no leaked queries)."""
+    _JOB_CTX.deadline = deadline
+    _JOB_CTX.cancel_event = cancel_event
+
+
+def clear_job_context() -> None:
+    _JOB_CTX.deadline = None
+    _JOB_CTX.cancel_event = None
+
+
+def _job_context() -> Tuple[Optional[float], Optional[object]]:
+    return (
+        getattr(_JOB_CTX, "deadline", None),
+        getattr(_JOB_CTX, "cancel_event", None),
+    )
+
+
+# ---------------------------------------------------------------------------
+# async host fallback pool
+# ---------------------------------------------------------------------------
+
+
+class _FallbackJob:
+    __slots__ = ("key", "raw_terms", "deadline", "cancel_event")
+
+    def __init__(self, key, raw_terms, deadline, cancel_event):
+        self.key = key
+        self.raw_terms = raw_terms
+        self.deadline = deadline
+        self.cancel_event = cancel_event
+
+    def dead(self) -> bool:
+        if self.cancel_event is not None and self.cancel_event.is_set():
+            return True
+        return self.deadline is not None and time.time() > self.deadline
+
+
+class FallbackPool:
+    """Bounded thread pool resolving hard (UNKNOWN) instances off the
+    round loop's critical path. Each worker owns a private
+    IncrementalCore — the process-global core must never be entered
+    concurrently. Results fold into the owning SolverCache."""
+
+    def __init__(
+        self,
+        cache: "SolverCache",
+        workers: int = FALLBACK_WORKERS,
+        queue_max: int = FALLBACK_QUEUE_MAX,
+        timeout_ms: int = FALLBACK_TIMEOUT_MS,
+        autostart: bool = True,
+    ):
+        self.cache = cache
+        self.workers = workers
+        self.queue_max = queue_max
+        self.timeout_ms = timeout_ms
+        self.autostart = autostart
+        self._queue: "deque[_FallbackJob]" = deque()
+        self._inflight_keys = set()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._threads: List[threading.Thread] = []
+        self._tls = threading.local()
+        # p95 source: in-flight depth sampled at every submit/complete
+        self._inflight_samples: "deque[int]" = deque(maxlen=1024)
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, key, raw_terms, deadline=None, cancel_event=None) -> bool:
+        """Queue one hard instance; False when dropped (full queue,
+        duplicate in-flight key, or already-dead job)."""
+        job = _FallbackJob(key, tuple(raw_terms), deadline, cancel_event)
+        if job.dead():
+            self.cache._count("async_dropped")
+            return False
+        with self._lock:
+            if len(self._queue) >= self.queue_max or key in self._inflight_keys:
+                return False
+            self._inflight_keys.add(key)
+            self._queue.append(job)
+            self._inflight_samples.append(len(self._inflight_keys))
+            self._wake.notify()
+        self.cache._count("async_submitted")
+        if self.autostart:
+            self._ensure_threads()
+        return True
+
+    def _ensure_threads(self) -> None:
+        with self._lock:
+            if self._threads:
+                return
+            for i in range(self.workers):
+                t = threading.Thread(
+                    target=self._worker_loop,
+                    name="solver-fallback-%d" % i,
+                    daemon=True,
+                )
+                self._threads.append(t)
+                t.start()
+
+    # -- processing -----------------------------------------------------
+
+    def _core(self) -> IncrementalCore:
+        core = getattr(self._tls, "core", None)
+        if core is None:
+            core = IncrementalCore()
+            self._tls.core = core
+        return core
+
+    def process_once(self, block: bool = False, timeout: float = 0.5) -> bool:
+        """Pop and resolve one queued instance on the CALLING thread
+        (workers loop on this; tests call it directly for determinism).
+        Returns False when the queue stayed empty."""
+        with self._lock:
+            if not self._queue and block:
+                self._wake.wait(timeout)
+            if not self._queue:
+                return False
+            job = self._queue.popleft()
+        try:
+            if job.dead():
+                self.cache._count("async_dropped")
+                return True
+            t0 = time.monotonic()
+            try:
+                code = _host_check(job.raw_terms, self.timeout_ms, self._core())
+            except Exception as e:  # pragma: no cover - worker never dies
+                log.warning("fallback solve failed: %s", e)
+                code = UNKNOWN
+            self.cache._add_time(time.monotonic() - t0)
+            if code != UNKNOWN:
+                self.cache.record(job.raw_terms, code, key=job.key)
+            self.cache._count("async_completed")
+        finally:
+            with self._lock:
+                self._inflight_keys.discard(job.key)
+                self._inflight_samples.append(len(self._inflight_keys))
+        return True
+
+    def _worker_loop(self) -> None:  # pragma: no cover - timing-dependent
+        while True:
+            self.process_once(block=True)
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Block until the queue and in-flight set are empty (tests,
+        end-of-job flush)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.autostart and self._threads:
+                with self._lock:
+                    idle = not self._queue and not self._inflight_keys
+                if idle:
+                    return
+                time.sleep(0.01)
+            else:
+                if not self.process_once():
+                    return
+
+    # -- stats ----------------------------------------------------------
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def inflight_p95(self) -> int:
+        with self._lock:
+            samples = sorted(self._inflight_samples)
+        if not samples:
+            return 0
+        return samples[min(len(samples) - 1, (len(samples) * 95) // 100)]
+
+
+# ---------------------------------------------------------------------------
+# the cache
+# ---------------------------------------------------------------------------
+
+# sentinel: _lookup did not attempt alpha canonicalization (distinct
+# from None, which means it was attempted and the set is too large)
+_NO_DIGEST = object()
+
+_STAT_KEYS = (
+    "queries",
+    "hits_exact",
+    "hits_alpha",
+    "hits_subsume",
+    "device_decided",
+    "host_decided",
+    "unknown",
+    "async_submitted",
+    "async_completed",
+    "async_dropped",
+)
+
+
+class SolverCache:
+    """Verdict memo + model store + subsumption index (module docstring)."""
+
+    def __init__(
+        self,
+        max_entries: int = 8192,
+        max_unsat: int = 256,
+        max_models: int = 1024,
+    ):
+        self.max_entries = max_entries
+        self.max_unsat = max_unsat
+        self.max_models = max_models
+        self._lock = threading.RLock()
+        # frozenset(uid) -> SAT/UNSAT/UNKNOWN
+        self._exact: "OrderedDict[frozenset, int]" = OrderedDict()
+        # alpha digest -> SAT/UNSAT (UNKNOWN is process-local: never alpha)
+        self._alpha: "OrderedDict[bytes, int]" = OrderedDict()
+        # UNSAT uid-sets for superset subsumption
+        self._unsat_sets: "OrderedDict[frozenset, None]" = OrderedDict()
+        # path-fp or frozenset -> named-symbol model dict (hints only)
+        self._models: "OrderedDict[object, dict]" = OrderedDict()
+        self._stats = {k: 0 for k in _STAT_KEYS}
+        self._time_s = 0.0
+        self.pool: Optional[FallbackPool] = None
+
+    # -- internals ------------------------------------------------------
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    def _add_time(self, dt: float) -> None:
+        with self._lock:
+            self._time_s += dt
+
+    @staticmethod
+    def _key_of(raw_terms: Sequence[Term]) -> frozenset:
+        return frozenset(t.uid for t in raw_terms if t is not terms.TRUE)
+
+    def _get_pool(self) -> FallbackPool:
+        with self._lock:
+            if self.pool is None:
+                self.pool = FallbackPool(self)
+            return self.pool
+
+    # -- lookup / record ------------------------------------------------
+
+    def lookup(self, raw_terms: Sequence[Term]) -> Tuple[Optional[int], frozenset]:
+        """(verdict or None, exact key). Checks: trivial, exact key,
+        UNSAT-superset subsumption, alpha key (promoting alpha hits to
+        the exact table)."""
+        code, key, _digest = self._lookup(raw_terms)
+        return code, key
+
+    def _lookup(self, raw_terms: Sequence[Term]):
+        """lookup plus the alpha digest IF one was computed (None =
+        computed but uncanonicalizable, _NO_DIGEST = not attempted).
+        decide_batch threads the digest into record() so a set is
+        alpha-hashed at most once per decision."""
+        if any(t is terms.FALSE for t in raw_terms):
+            return UNSAT, frozenset(), _NO_DIGEST
+        key = self._key_of(raw_terms)
+        if not key:
+            return SAT, key, _NO_DIGEST
+        with self._lock:
+            code = self._exact.get(key)
+            if code is not None:
+                self._exact.move_to_end(key)
+                self._stats["hits_exact"] += 1
+                return code, key, _NO_DIGEST
+            for fs in self._unsat_sets:
+                if fs <= key:
+                    self._stats["hits_subsume"] += 1
+                    self._promote(key, UNSAT)
+                    return UNSAT, key, _NO_DIGEST
+            alpha_live = bool(self._alpha)
+        # an empty alpha table cannot hit: skip the O(DAG) digest work
+        # entirely (the common case on a fresh analysis — record() fills
+        # the table only with decided verdicts)
+        if not alpha_live:
+            return None, key, _NO_DIGEST
+        digest = canonical_fingerprint(raw_terms)
+        if digest is not None:
+            with self._lock:
+                code = self._alpha.get(digest)
+                if code is not None:
+                    self._alpha.move_to_end(digest)
+                    self._stats["hits_alpha"] += 1
+                    self._promote(key, code)
+                    return code, key, digest
+        return None, key, digest
+
+    def _promote(self, key: frozenset, code: int) -> None:
+        """Install a derived verdict in the exact table (lock held)."""
+        self._exact[key] = code
+        self._exact.move_to_end(key)
+        while len(self._exact) > self.max_entries:
+            self._exact.popitem(last=False)
+
+    def record(
+        self,
+        raw_terms: Sequence[Term],
+        code: int,
+        key: Optional[frozenset] = None,
+        model: Optional[dict] = None,
+        path_fp: Optional[int] = None,
+        digest=None,
+    ) -> None:
+        """Fold one verdict (and optionally its model) into the tables.
+        ``digest`` forwards an alpha digest already computed by
+        _lookup (pass _NO_DIGEST-sentinel-free values only)."""
+        if key is None:
+            key = self._key_of(raw_terms)
+        if not key:
+            return
+        if code in (SAT, UNSAT):
+            if digest is None:
+                digest = canonical_fingerprint(raw_terms)
+        else:
+            digest = None
+        with self._lock:
+            self._exact[key] = code
+            self._exact.move_to_end(key)
+            while len(self._exact) > self.max_entries:
+                self._exact.popitem(last=False)
+            if digest is not None:
+                self._alpha[digest] = code
+                self._alpha.move_to_end(digest)
+                while len(self._alpha) > self.max_entries:
+                    self._alpha.popitem(last=False)
+            if code == UNSAT:
+                self._unsat_sets[key] = None
+                self._unsat_sets.move_to_end(key)
+                while len(self._unsat_sets) > self.max_unsat:
+                    self._unsat_sets.popitem(last=False)
+            if code == SAT and model:
+                self._models[key] = model
+                if path_fp is not None:
+                    self._models[path_fp] = model
+                while len(self._models) > self.max_models:
+                    self._models.popitem(last=False)
+
+    def model_hint(self, prefix_fps) -> Optional[dict]:
+        """The nearest-ancestor cached model for a lane's path-prefix
+        fingerprint chain (warm-start hint; staleness is harmless)."""
+        if not prefix_fps:
+            return None
+        with self._lock:
+            for fp in reversed(prefix_fps):
+                model = self._models.get(fp)
+                if model is not None:
+                    return model
+        return None
+
+    # -- the round-loop entry point --------------------------------------
+
+    def decide_batch(
+        self,
+        sets: Sequence[Sequence[Term]],
+        use_device: bool = True,
+        flips: int = 384,
+        hints: Optional[Sequence] = None,
+        host_fallback: bool = True,
+    ) -> List[Optional[bool]]:
+        """Decide a frontier of constraint sets: memo -> device batch ->
+        inline quick host check -> async pool.
+
+        Returns True (feasible) / False (infeasible) / None (unknown —
+        the caller should treat the lane as possible; the async pool
+        may fold an UNSAT in later, which subsumption then applies to
+        the lane's descendants). ``host_fallback=False`` stops after
+        the device dispatch (the lazy-screen triage path: unknown parks
+        go to settlement, not to the host).
+
+        Host economics: when the device DID run, its residue goes to
+        the ASYNC pool only (and only in service mode — see _pool_armed)
+        — a blocking 100 ms host check per unknown was measured to
+        dominate round wall time on unknown-heavy workloads (BECStress:
+        ~100% of deep instances), and the round loop treating unknown
+        as possible is exactly Constraints.is_possible semantics with
+        settlement re-solving authoritatively before any report. The
+        inline quick check runs only when the device did NOT run
+        (pre-warmup / sub-floor frontiers): there it is the only
+        pruning the frontier gets."""
+        from mythril_tpu.laser.tpu import solver_jax
+
+        t0 = time.monotonic()
+        n = len(sets)
+        self._count("queries", n)
+        verdicts: List[Optional[bool]] = [None] * n
+        keys: List[Optional[frozenset]] = [None] * n
+        digests: List[object] = [_NO_DIGEST] * n
+        decided = [False] * n
+        pending: List[int] = []
+        for i, cs in enumerate(sets):
+            code, key, digest = self._lookup(cs)
+            keys[i] = key
+            digests[i] = digest
+            if code is None:
+                pending.append(i)
+                continue
+            decided[i] = True
+            if code == SAT:
+                verdicts[i] = True
+            elif code == UNSAT:
+                verdicts[i] = False
+            # cached UNKNOWN: stay None, but do NOT re-solve (the whole
+            # point: this set already exhausted both budgets)
+
+        if use_device and pending:
+            sub = [sets[i] for i in pending]
+            warm = None
+            if hints is not None:
+                warm = [self.model_hint(hints[i]) for i in pending]
+            dev_models: List[Optional[dict]] = [None] * len(sub)
+            try:
+                out = solver_jax.feasibility_batch(
+                    sub, flips=flips, models=warm, return_models=True
+                )
+            except TypeError:
+                # narrower legacy signature (test doubles)
+                try:
+                    out = solver_jax.feasibility_batch(sub, flips=flips)
+                except Exception as e:  # pragma: no cover - device degrade
+                    log.warning("device feasibility batch failed: %s", e)
+                    out = [None] * len(sub)
+            except Exception as e:
+                log.warning("device feasibility batch failed: %s", e)
+                out = [None] * len(sub)
+            if isinstance(out, tuple):
+                dev_verdicts, dev_models = out
+            else:
+                dev_verdicts = out
+            for j, i in enumerate(pending):
+                v = dev_verdicts[j]
+                if v is None:
+                    continue
+                verdicts[i] = v
+                decided[i] = True
+                self._count("device_decided")
+                fp = None
+                if hints is not None and hints[i]:
+                    fp = hints[i][-1]
+                self.record(
+                    sets[i],
+                    SAT if v else UNSAT,
+                    key=keys[i],
+                    model=dev_models[j],
+                    path_fp=fp,
+                    digest=self._digest_or_none(digests[i]),
+                )
+            pending = [i for i in pending if not decided[i]]
+
+        if host_fallback and pending:
+            deadline, cancel_event = _job_context()
+            pool_armed = self._pool_armed(cancel_event, deadline)
+            for i in pending:
+                if use_device:
+                    # device residue: optimistic + async (see docstring)
+                    self._count("unknown")
+                    self.record(sets[i], UNKNOWN, key=keys[i])
+                    if pool_armed:
+                        self._get_pool().submit(
+                            keys[i],
+                            sets[i],
+                            deadline=deadline,
+                            cancel_event=cancel_event,
+                        )
+                    continue
+                code = _host_check(sets[i], HOST_BUDGET_MS)
+                if code == SAT:
+                    verdicts[i] = True
+                    self._count("host_decided")
+                    self.record(
+                        sets[i], SAT, key=keys[i],
+                        digest=self._digest_or_none(digests[i]),
+                    )
+                elif code == UNSAT:
+                    verdicts[i] = False
+                    self._count("host_decided")
+                    self.record(
+                        sets[i], UNSAT, key=keys[i],
+                        digest=self._digest_or_none(digests[i]),
+                    )
+                else:
+                    self._count("unknown")
+                    self.record(sets[i], UNKNOWN, key=keys[i])
+                    if pool_armed:
+                        self._get_pool().submit(
+                            keys[i],
+                            sets[i],
+                            deadline=deadline,
+                            cancel_event=cancel_event,
+                        )
+        self._add_time(time.monotonic() - t0)
+        return verdicts
+
+    @staticmethod
+    def _digest_or_none(digest) -> Optional[bytes]:
+        return None if digest is _NO_DIGEST else digest
+
+    def _pool_armed(self, cancel_event, deadline) -> bool:
+        """The async pool engages only in SERVICE mode (a job context is
+        installed, or a pool was armed explicitly). A lone CLI/bench
+        analysis must not spawn host CDCL worker threads: the solver is
+        pure Python, so workers contend with the round loop for the GIL
+        and were measured to starve it outright on CPU backends."""
+        return (
+            self.pool is not None
+            or cancel_event is not None
+            or deadline is not None
+        )
+
+    # -- cross-job memo sharing (service/cache.py) -----------------------
+
+    def export_memo(self, limit: int = 4096) -> Dict[bytes, int]:
+        """The most recent decided alpha entries (structure-keyed —
+        stable across processes and resubmissions)."""
+        with self._lock:
+            items = list(self._alpha.items())
+        return dict(items[-limit:])
+
+    def seed_memo(self, memo: Optional[Dict[bytes, int]]) -> None:
+        if not memo:
+            return
+        with self._lock:
+            for digest, code in memo.items():
+                if code in (SAT, UNSAT) and digest not in self._alpha:
+                    self._alpha[digest] = code
+            while len(self._alpha) > self.max_entries:
+                self._alpha.popitem(last=False)
+
+    # -- stats ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._stats)
+            out["time_s"] = self._time_s
+        pool = self.pool
+        if pool is not None:
+            out["inflight_p95"] = pool.inflight_p95()
+            out["pending"] = pool.pending()
+        else:
+            out["inflight_p95"] = 0
+            out["pending"] = 0
+        out["hits"] = out["hits_exact"] + out["hits_alpha"] + out["hits_subsume"]
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        return self.snapshot()
+
+    def hit_rate(self) -> float:
+        s = self.snapshot()
+        return (s["hits"] / s["queries"]) if s["queries"] else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._exact.clear()
+            self._alpha.clear()
+            self._unsat_sets.clear()
+            self._models.clear()
+            self._stats = {k: 0 for k in _STAT_KEYS}
+            self._time_s = 0.0
+            pool = self.pool
+        if pool is not None:
+            with pool._lock:
+                pool._queue.clear()
+                pool._inflight_keys.clear()
+                pool._inflight_samples.clear()
+
+
+GLOBAL = SolverCache()
+
+
+def warm_device(constraint_sets, flips: Optional[int] = None) -> None:
+    """Compile the device solver's kernels (backend warmup passthrough,
+    keeping direct solver_jax calls inside this boundary)."""
+    from mythril_tpu.laser.tpu import solver_jax
+
+    solver_jax.check_batch(constraint_sets, flips=flips)
+
+
+def reset_for_tests() -> None:
+    GLOBAL.reset()
+    clear_job_context()

@@ -157,6 +157,34 @@ def node_hash(op, a, b, imm, xp=jnp):
     return run(0x811C9DC5, 0x9E3779B1), run(0x01000193, 0x85EBCA77)
 
 
+def path_fingerprint(h1, h2, signs):
+    """Cumulative 64-bit fingerprints of a lane's branch-condition
+    prefix: entry j identifies the constraint prefix of length j+1.
+
+    Chained (order-sensitive) over the per-node identity hashes
+    (node_hash planes) and branch signs, so forked siblings — which
+    share the parent's tape and therefore the parent's (h1, h2, sign)
+    sequence verbatim — produce IDENTICAL prefix entries. The solver
+    cache keys warm-start models by these: a child looks up the nearest
+    ancestor fingerprint to seed the device search from the parent
+    path's model (hint only — never a verdict key).
+
+    Host-side numpy; returns uint64[n]."""
+    h1 = np.asarray(h1, dtype=np.uint64)
+    h2 = np.asarray(h2, dtype=np.uint64)
+    signs = np.asarray(signs, dtype=np.uint64)
+    out = np.zeros(h1.shape[0], dtype=np.uint64)
+    acc = np.uint64(0xCBF29CE484222325)
+    mul = np.uint64(0xBF58476D1CE4E5B9)
+    with np.errstate(over="ignore"):
+        for j in range(h1.shape[0]):
+            v = (h1[j] << np.uint64(33)) ^ (h2[j] << np.uint64(1)) ^ signs[j]
+            acc = (acc ^ v) * mul
+            acc = acc ^ (acc >> np.uint64(29))
+            out[j] = acc
+    return out
+
+
 HOST_META = 0xFFFFFFFF  # tape_meta sentinel: node packed by the host
 
 

@@ -73,6 +73,13 @@ class ResultCache:
         self.max_entries = max_entries
         self._lock = threading.Lock()
         self._entries: "OrderedDict[bytes, CacheEntry]" = OrderedDict()
+        # per-code-hash solver verdict memos (alpha-canonical digest ->
+        # SAT/UNSAT, laser/tpu/solver_cache.py). PARAM-INDEPENDENT,
+        # unlike result entries: a constraint set's satisfiability does
+        # not depend on budgets or module whitelists, so a resubmission
+        # with different parameters still starts with warm verdicts.
+        self._solver_memos: "OrderedDict[bytes, Dict[bytes, int]]" = OrderedDict()
+        self.solver_memo_max = 128
         self.hits = 0
         self.misses = 0
 
@@ -120,6 +127,34 @@ class ResultCache:
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
         return entry
+
+    # -- solver verdict memos (tentpole: cross-resubmission warmth) -----
+
+    def get_solver_memo(self, key: bytes) -> Optional[Dict[bytes, int]]:
+        """The accumulated solver verdict memo for a code hash (a copy;
+        seed it into solver_cache.GLOBAL before running the job)."""
+        with self._lock:
+            memo = self._solver_memos.get(key)
+            if memo is None:
+                return None
+            self._solver_memos.move_to_end(key)
+            return dict(memo)
+
+    def put_solver_memo(self, key: bytes, memo: Dict[bytes, int]) -> None:
+        """Merge a finished job's exported verdicts into the code hash's
+        memo (merge, not replace: later jobs under other parameters may
+        have explored different regions)."""
+        if not memo:
+            return
+        with self._lock:
+            entry = self._solver_memos.get(key)
+            if entry is None:
+                entry = {}
+                self._solver_memos[key] = entry
+            entry.update(memo)
+            self._solver_memos.move_to_end(key)
+            while len(self._solver_memos) > self.solver_memo_max:
+                self._solver_memos.popitem(last=False)
 
     @staticmethod
     def _reseed_static_pass(tables) -> None:

@@ -351,6 +351,20 @@ class AnalysisService:
         self.coordinator.job_started()
         issues = []
         error: Optional[str] = None
+        # solver-seam warmth + fallback hygiene (laser/tpu/solver_cache):
+        # seed the verdict memo accumulated by earlier runs of this code
+        # hash, and tag this thread's async host-solver submissions with
+        # the job's deadline and cancel event so a cancelled or expired
+        # job's pending queries are DROPPED by the pool, never solved.
+        from mythril_tpu.laser.tpu import solver_cache
+
+        solver_cache.GLOBAL.seed_memo(self.cache.get_solver_memo(job.key))
+        solver_cache.set_job_context(
+            deadline=(
+                job.started_at + float(job.timeout) if job.timeout else None
+            ),
+            cancel_event=job.cancel_event,
+        )
         try:
             contract = EVMContract(
                 code=job.runtime_hex,
@@ -378,6 +392,7 @@ class AnalysisService:
             log.warning("job %d failed: %s", job.id, e)
             error = str(e)
         finally:
+            solver_cache.clear_job_context()
             self.coordinator.job_finished()
 
         if job.cancel_event.is_set():
@@ -402,6 +417,9 @@ class AnalysisService:
         }
         job.finish(JobState.DONE)
         self.jobs_done += 1
+        # export the verdicts this job decided so resubmissions of the
+        # same contract (any parameters) start with a warm memo table
+        self.cache.put_solver_memo(job.key, solver_cache.GLOBAL.export_memo())
         self.cache.put(
             job.key,
             job.tx_count,

@@ -29,6 +29,16 @@ python -m pytest tests/analysis/test_static_pass.py \
     tests/analysis/test_disassembler_truncated.py \
     -q -p no:cacheprovider -k "golden or cache or push or scan"
 
+echo "== solver fast tests =="
+# the solver boundary: memo/subsumption/fingerprints, pool cancellation
+# hygiene, and the pad-ladder compile bound. Deselect the on-device
+# classes (they compile XLA kernels; the full suite runs them) — the
+# memo and pool logic here is pure host-side and runs in seconds.
+python -m pytest tests/laser/test_solver_cache.py \
+    tests/laser/test_solver_fallback.py \
+    -q -p no:cacheprovider \
+    -k "not on_device and not witness"
+
 echo "== service fast tests =="
 # scheduler/cache/api lifecycle with the pipeline stubbed out — no
 # symbolic execution; the real multi-tenant integration runs in

@@ -369,6 +369,60 @@ def lane_indexing(tree: ast.AST, source: str, rel: str):
     return sorted(set(out))
 
 
+# Callables that reach a SAT/SMT backend directly. All feasibility
+# decisions in product code must flow through the solver boundary
+# (laser/tpu/solver_cache.py, which memoizes and subsumes, and
+# laser/tpu/solver_jax.py, which owns the device kernel) so verdicts
+# are cached once and accounted once — a stray get_core()/solve_checked
+# call bypasses the memo AND the time/hit accounting (docs/SOLVER.md).
+# ``reset_core`` stays allowed: it is solver lifecycle (fresh core per
+# analysis), not a feasibility decision.
+_SOLVER_ENTRYPOINTS = {
+    "get_core",
+    "feasibility_batch",
+    "check_batch",
+    "solve_checked",
+    "IncrementalCore",
+}
+
+# Modules allowed to touch solver entrypoints: the smt layer that OWNS
+# them, and the two boundary modules.
+_SOLVER_BOUNDARY_ALLOWED = {
+    "mythril_tpu/laser/tpu/solver_jax.py",
+    "mythril_tpu/laser/tpu/solver_cache.py",
+}
+
+
+def solver_boundary(tree: ast.AST, source: str, rel: str):
+    """(lineno, desc) pairs for direct host/device solver entrypoint
+    references in product code outside the solver boundary. Tests are
+    exempt (they stub and assert on these names); noqa exempts a
+    deliberate exception."""
+    if not rel.startswith("mythril_tpu/") or rel in _SOLVER_BOUNDARY_ALLOWED:
+        return []
+    if rel.startswith("mythril_tpu/smt/"):
+        return []
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Attribute) and node.attr in _SOLVER_ENTRYPOINTS:
+            name = node.attr
+        elif (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in _SOLVER_ENTRYPOINTS
+        ):
+            name = node.id
+        if name is not None and not _noqa(lines, node.lineno):
+            out.append((
+                node.lineno,
+                f"direct solver entrypoint '{name}' outside the "
+                "solver_cache/solver_jax boundary",
+            ))
+    return sorted(set(out))
+
+
 def main() -> int:
     problems = []
     n_files = 0
@@ -392,6 +446,8 @@ def main() -> int:
         for lineno, desc in swallowed_exceptions(tree, source):
             problems.append(f"{rel}:{lineno}: {desc}")
         for lineno, desc in lane_indexing(tree, source, str(rel)):
+            problems.append(f"{rel}:{lineno}: {desc}")
+        for lineno, desc in solver_boundary(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")

@@ -11,6 +11,7 @@ from mythril_tpu.analysis.potential_issues import (
     PotentialIssue,
     PotentialIssuesAnnotation,
 )
+from mythril_tpu.smt import symbol_factory
 
 from tests.analysis.conftest import SMALL_BATCH_CFG, analyze_contract
 
@@ -46,6 +47,12 @@ class _FakeState:
 
 
 def _issue(screened, key=None):
+    # a real (symbolic, non-trivial) finding constraint: trivially-empty
+    # sets are decided by the solver cache's memo without any device
+    # dispatch, which is not what parked findings look like
+    probe = symbol_factory.BitVecSym("triage_probe", 8) == symbol_factory.BitVecVal(
+        1, 8
+    )
     issue = PotentialIssue(
         contract="C",
         function_name="f",
@@ -54,6 +61,7 @@ def _issue(screened, key=None):
         title="t",
         bytecode="",
         detector=None,
+        constraints=[probe],
         screened=screened,
         screen_key=key,
     )

@@ -53,3 +53,18 @@ _solver_jax.MAX_CLAUSES = 2048
 from mythril_tpu.laser.tpu import backend as _backend  # noqa: E402
 
 _backend.WARMUP_ASYNC = False
+
+# The solver verdict memo (laser/tpu/solver_cache.GLOBAL) is keyed by
+# interned term uids and alpha-digests, both stable process-wide — a
+# verdict recorded by one test would answer a lookup in the next and
+# mask real solver behaviour. Reset it around every test.
+import pytest  # noqa: E402
+
+from mythril_tpu.laser.tpu import solver_cache as _solver_cache  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_solver_cache():
+    _solver_cache.reset_for_tests()
+    yield
+    _solver_cache.reset_for_tests()

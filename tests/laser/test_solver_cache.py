@@ -1,0 +1,457 @@
+"""Solver acceleration layer (laser/tpu/solver_cache.py): canonical
+fingerprints, verdict memoization, UNSAT subsumption, warm-started
+device solves, the bounded pad ladder, and the async host fallback
+pool's cancellation hygiene. Soundness gate: every memoized verdict
+must match a fresh host CDCL answer on the same set."""
+
+import random
+import threading
+import time
+
+import numpy as np
+
+from mythril_tpu.laser.tpu import solver_cache as sc
+from mythril_tpu.laser.tpu import solver_jax as sj
+from mythril_tpu.laser.tpu import symtape
+from mythril_tpu.service.cache import ResultCache
+from mythril_tpu.smt import ULT, UGT, symbol_factory
+from mythril_tpu.smt.solver.incremental import IncrementalCore
+
+W = 16  # small words keep host CDCL and the CPU kernel fast
+
+
+def bv(name):
+    return symbol_factory.BitVecSym(name, W)
+
+
+def val(v):
+    return symbol_factory.BitVecVal(v, W)
+
+
+def formulas(prefix, seed, count=10):
+    """Deterministic corpus; the same seed with a different prefix
+    yields the SAME structure over renamed symbols. Atoms are kept
+    asymmetric (distinct constants, distinct arg positions) so the
+    canonical ordering has no symmetric ties."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(count):
+        a = bv("%s_a%d" % (prefix, i))
+        b = bv("%s_b%d" % (prefix, i))
+        c = bv("%s_c%d" % (prefix, i))
+        k1, k2, k3 = (val(v) for v in rng.sample(range(1, 1 << W), 3))
+        atoms = [a + k1 == b, ULT(a, k2), UGT(b, k3), b - a == c]
+        out.append([t.raw for t in atoms[: rng.randrange(2, 5)]])
+    return out
+
+
+def fresh_host_verdict(raw_terms):
+    """Ground truth: a generously-budgeted check on a PRIVATE core."""
+    return sc._host_check(raw_terms, 10_000, core=IncrementalCore())
+
+
+# ---------------------------------------------------------------------------
+# canonical fingerprints (satellite: property test)
+# ---------------------------------------------------------------------------
+
+
+class TestCanonicalFingerprint:
+    def test_order_insensitive(self):
+        for fs in formulas("ord", 11):
+            d1 = sc.canonical_fingerprint(fs)
+            d2 = sc.canonical_fingerprint(list(reversed(fs)))
+            assert d1 == d2 and d1 is not None
+
+    def test_duplicates_collapse(self):
+        t = (bv("dup_a") == val(9)).raw
+        assert sc.canonical_fingerprint([t, t]) == sc.canonical_fingerprint([t])
+
+    def test_rename_insensitive(self):
+        left = formulas("lft", 23)
+        right = formulas("rgt", 23)
+        for fs_l, fs_r in zip(left, right):
+            rng = random.Random(hash(len(fs_l)))
+            shuffled = list(fs_r)
+            rng.shuffle(shuffled)
+            assert sc.canonical_fingerprint(fs_l) == sc.canonical_fingerprint(
+                shuffled
+            )
+
+    def test_distinct_sets_distinct_digests(self):
+        corpus = formulas("dst", 37, count=12)
+        digests = [sc.canonical_fingerprint(fs) for fs in corpus]
+        assert len(set(digests)) == len(digests)
+
+    def test_node_cap_returns_none(self, monkeypatch):
+        monkeypatch.setattr(sc, "ALPHA_NODE_CAP", 2)
+        fs = formulas("cap", 5, count=1)[0]
+        assert sc.canonical_fingerprint(fs) is None
+
+
+# ---------------------------------------------------------------------------
+# verdict memoization + subsumption
+# ---------------------------------------------------------------------------
+
+
+def counting_host_check(code):
+    calls = []
+
+    def fake(raw_terms, timeout_ms, core=None):
+        calls.append(tuple(raw_terms))
+        return code
+
+    return calls, fake
+
+
+class TestMemoization:
+    def test_exact_hit_skips_every_solver(self, monkeypatch):
+        cache = sc.SolverCache()
+        fs = [(bv("ex_a") == val(3)).raw]
+        calls, fake = counting_host_check(sc.SAT)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        first = cache.decide_batch([fs], use_device=False)
+        second = cache.decide_batch([fs], use_device=False)
+        assert first == [True] and second == [True]
+        assert len(calls) == 1  # second query answered from the memo
+        s = cache.stats()
+        assert s["hits_exact"] == 1 and s["queries"] == 2
+
+    def test_unsat_superset_subsumed_without_solve(self, monkeypatch):
+        cache = sc.SolverCache()
+        a = bv("sub_a")
+        core = [(a == val(1)).raw, (a == val(2)).raw]
+        cache.record(core, sc.UNSAT)
+        calls, fake = counting_host_check(sc.SAT)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        superset = core + [ULT(a, val(50)).raw]
+        out = cache.decide_batch([superset], use_device=False)
+        assert out == [False]
+        assert not calls  # subsumption decided it; nothing was solved
+        assert cache.stats()["hits_subsume"] == 1
+        # the derived verdict is promoted: the re-query is an exact hit
+        code, _ = cache.lookup(superset)
+        assert code == sc.UNSAT and cache.stats()["hits_exact"] == 1
+
+    def test_alpha_hit_across_renaming(self, monkeypatch):
+        cache = sc.SolverCache()
+        left = formulas("mla", 51, count=4)
+        right = formulas("mlb", 51, count=4)
+        for fs in left:
+            cache.record(fs, fresh_host_verdict(fs))
+        calls, fake = counting_host_check(sc.SAT)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        out = cache.decide_batch(right, use_device=False)
+        assert not calls
+        assert cache.stats()["hits_alpha"] == len(right)
+        for fs, verdict in zip(left, out):
+            assert verdict is (fresh_host_verdict(fs) == sc.SAT)
+
+    def test_unknown_memoized_not_resolved(self, monkeypatch):
+        cache = sc.SolverCache()
+        cache.pool = sc.FallbackPool(cache, autostart=False)
+        fs = [(bv("unk_a") == val(4)).raw]
+        calls, fake = counting_host_check(sc.UNKNOWN)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        assert cache.decide_batch([fs], use_device=False) == [None]
+        assert cache.decide_batch([fs], use_device=False) == [None]
+        assert len(calls) == 1  # cached UNKNOWN is NOT re-solved inline
+        assert cache.stats()["unknown"] == 1
+
+    def test_triage_mode_never_touches_host(self, monkeypatch):
+        cache = sc.SolverCache()
+        fs = [(bv("tri_a") == val(6)).raw]
+        calls, fake = counting_host_check(sc.SAT)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        out = cache.decide_batch([fs], use_device=False, host_fallback=False)
+        assert out == [None] and not calls
+        assert cache.pool is None  # and nothing was queued
+
+    def test_memoized_matches_fresh_host(self):
+        """Satellite gate: verdicts served from the memo are bit-for-bit
+        the verdicts a fresh host solver computes."""
+        cache = sc.SolverCache()
+        corpus = formulas("prop", 97, count=10)
+        first = cache.decide_batch(corpus, use_device=False)
+        again = cache.decide_batch(corpus, use_device=False)
+        assert again == first  # stable under memoization
+        for fs, verdict in zip(corpus, first):
+            truth = fresh_host_verdict(fs)
+            if verdict is True:
+                assert truth == sc.SAT
+            elif verdict is False:
+                assert truth == sc.UNSAT
+        s = cache.stats()
+        assert s["hits_exact"] == len(corpus)
+
+    def test_model_hint_nearest_ancestor(self):
+        cache = sc.SolverCache()
+        fs = [(bv("mh_a") == val(5)).raw]
+        cache.record(fs, sc.SAT, model={("bv", "mh_a", W): 5}, path_fp=111)
+        assert cache.model_hint((111,)) == {("bv", "mh_a", W): 5}
+        # nearest ancestor wins: later fps are searched first
+        cache.record(fs, sc.SAT, model={("bv", "mh_a", W): 7}, path_fp=222)
+        assert cache.model_hint((111, 222)) == {("bv", "mh_a", W): 7}
+        assert cache.model_hint((999,)) is None
+
+
+# ---------------------------------------------------------------------------
+# async host fallback pool (satellite: cancellation hygiene)
+# ---------------------------------------------------------------------------
+
+
+class TestFallbackPool:
+    def _cache(self):
+        cache = sc.SolverCache()
+        cache.pool = sc.FallbackPool(cache, autostart=False)
+        return cache
+
+    def test_cancelled_job_dropped_at_submit(self):
+        cache = self._cache()
+        ev = threading.Event()
+        ev.set()
+        fs = [(bv("fc_a") == val(1)).raw]
+        ok = cache.pool.submit(cache._key_of(fs), fs, cancel_event=ev)
+        assert ok is False and cache.pool.pending() == 0
+        assert cache.stats()["async_dropped"] == 1
+
+    def test_expired_deadline_dropped_at_submit(self):
+        cache = self._cache()
+        fs = [(bv("fd_a") == val(1)).raw]
+        ok = cache.pool.submit(
+            cache._key_of(fs), fs, deadline=time.time() - 1.0
+        )
+        assert ok is False and cache.pool.pending() == 0
+        assert cache.stats()["async_dropped"] == 1
+
+    def test_cancelled_after_queue_dropped_at_dequeue(self, monkeypatch):
+        """Regression (satellite): a job cancelled AFTER its queries were
+        queued must have them dropped at dequeue — never solved, never
+        leaked in the in-flight set."""
+        cache = self._cache()
+        ev = threading.Event()
+        fs = [(bv("fq_a") == val(1)).raw]
+        key = cache._key_of(fs)
+        assert cache.pool.submit(key, fs, cancel_event=ev) is True
+        assert cache.pool.pending() == 1
+        ev.set()  # job dies while the query waits
+        calls, fake = counting_host_check(sc.SAT)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        assert cache.pool.process_once() is True
+        assert not calls  # dropped, not solved
+        assert cache.pool.pending() == 0
+        assert not cache.pool._inflight_keys  # not leaked
+        s = cache.stats()
+        assert s["async_dropped"] == 1 and s["async_completed"] == 0
+
+    def test_result_folds_into_memo_and_subsumes(self):
+        cache = self._cache()
+        a = bv("ff_a")
+        hard = [(a == val(1)).raw, (a == val(2)).raw]
+        key = cache._key_of(hard)
+        assert cache.pool.submit(key, hard) is True
+        assert cache.pool.process_once() is True
+        assert cache.stats()["async_completed"] == 1
+        code, _ = cache.lookup(hard)
+        assert code == sc.UNSAT
+        # ...and the late UNSAT prunes descendants via subsumption
+        child = hard + [ULT(a, val(9)).raw]
+        code, _ = cache.lookup(child)
+        assert code == sc.UNSAT
+
+    def test_duplicate_inflight_key_not_requeued(self):
+        cache = self._cache()
+        fs = [(bv("fk_a") == val(1)).raw]
+        key = cache._key_of(fs)
+        assert cache.pool.submit(key, fs) is True
+        assert cache.pool.submit(key, fs) is False
+        assert cache.pool.pending() == 1
+
+    def test_decide_batch_tags_submissions_with_job_context(self, monkeypatch):
+        """The scheduler sets the job context around execution; verdicts
+        parked as UNKNOWN must carry the job's cancel event into the
+        pool so a later cancellation drops them."""
+        cache = self._cache()
+        ev = threading.Event()
+        calls, fake = counting_host_check(sc.UNKNOWN)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        sc.set_job_context(deadline=time.time() + 60, cancel_event=ev)
+        try:
+            fs = [(bv("fj_a") == val(3)).raw]
+            cache.decide_batch([fs], use_device=False)
+        finally:
+            sc.clear_job_context()
+        assert cache.pool.pending() == 1
+        job = cache.pool._queue[0]
+        assert job.cancel_event is ev and job.deadline is not None
+        ev.set()
+        assert cache.pool.process_once() is True
+        assert cache.stats()["async_dropped"] == 1
+        assert len(calls) == 1  # only the inline quick check ran
+
+
+# ---------------------------------------------------------------------------
+# pad ladder (satellite: bounded jit specializations)
+# ---------------------------------------------------------------------------
+
+
+class TestPadLadder:
+    def test_pow2_ladder_clamps_growth(self):
+        ladder = (8, 64)
+        assert sj._pow2(1, ladder=ladder) == 8
+        assert sj._pow2(8, ladder=ladder) == 8
+        assert sj._pow2(9, ladder=ladder) == 64
+        assert sj._pow2(1000, ladder=ladder) == 64  # clamped, not 1024
+        # free growth (no ladder) is still plain next-pow2
+        assert sj._pow2(9, lo=16) == 16
+        assert sj._pow2(17, lo=16) == 32
+
+    def test_select_bucket_stays_on_ladder(self):
+        """Growing instance sizes under the caps map onto at most
+        len(shape_ladder()) distinct (vars, clauses) buckets."""
+        ladder = sj.shape_ladder()
+        seen = set()
+        for nv in range(1, sj.MAX_VARS + 1, 37):
+            nc = min(sj.MAX_CLAUSES, nv * 3 + 1)
+            seen.add(sj._select_bucket(nv, nc))
+        assert seen <= set(ladder)
+        assert len(seen) <= len(ladder)
+
+    def test_select_bucket_promotes_to_compiled(self):
+        saved = set(sj._compiled_shapes)
+        try:
+            sj._compiled_shapes.clear()
+            ladder = sj.shape_ladder()
+            small, big = ladder[0], ladder[-1]
+            assert sj._select_bucket(1, 1) == small
+            # once the big bucket is compiled, small work rides it
+            # (padding waste beats another XLA compile)
+            sj._compiled_shapes.add((8, big[0], big[1], 64))
+            assert sj._select_bucket(1, 1) == big
+        finally:
+            sj._compiled_shapes.clear()
+            sj._compiled_shapes.update(saved)
+
+    def test_compiled_shapes_bounded_on_device(self):
+        """Real dispatches over a batch of growing instances: the jit
+        specialization count stays under the ladder bound instead of
+        growing with instance size."""
+        saved = set(sj._compiled_shapes)
+        try:
+            sj._compiled_shapes.clear()
+            a, b = bv("lad_a"), bv("lad_b")
+            rounds = [
+                [[(a == val(5)).raw]],
+                [[(a == val(5)).raw, (b == val(6)).raw]],
+                [[(a + b == val(77)).raw]],
+                [[(a + b == val(77)).raw, ULT(a, b).raw]],
+            ]
+            for sets in rounds:
+                sj.check_batch(sets, flips=64)
+            bound = len(sj.shape_ladder()) * len(sj._BATCH_LADDER)
+            assert 0 < len(sj._compiled_shapes) <= bound
+        finally:
+            sj._compiled_shapes.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# warm starts + witness models
+# ---------------------------------------------------------------------------
+
+
+class TestWarmStart:
+    def test_warm_plane_matches_extracted_model(self):
+        inst = sj.compile_cnf([(bv("wp_a") == val(0xA5)).raw])
+        assert inst is not None and inst.var_bits
+        model = {("bv", "wp_a", W): 0xA5}
+        V = inst.nvars + 8
+        warm = sj._warm_plane([inst], [model], 1, V)
+        assert warm.any()
+        # the hint plane IS the assignment the model describes: feeding
+        # it back through _extract_model recovers the value
+        assign_row = warm[0] > 0
+        out = sj._extract_model(inst, assign_row)
+        assert out[("bv", "wp_a", W)] == 0xA5
+
+    def test_device_witness_satisfies_and_reseeds(self):
+        a, b = bv("ws_a"), bv("ws_b")
+        fs = [(a + b == val(0x123)).raw, ULT(a, b).raw]
+        codes, models = sj.check_batch([fs], flips=64, return_models=True)
+        if codes[0] != sj.SAT:  # CPU kernel may time out under 64 flips
+            return
+        m = models[0]
+        av = m[("bv", "ws_a", W)]
+        bvv = m[("bv", "ws_b", W)]
+        assert (av + bvv) % (1 << W) == 0x123 and av < bvv
+        # warm-started re-solve from its own witness stays SAT
+        codes2 = sj.check_batch([fs], flips=64, models=[m])
+        assert codes2[0] == sj.SAT
+
+    def test_decide_batch_on_device(self):
+        cache = sc.SolverCache()
+        a = bv("db_a")
+        sat_set = [(a == val(7)).raw]
+        unsat_set = [(a == val(7)).raw, (a == val(9)).raw]
+        out = cache.decide_batch([sat_set, unsat_set], flips=64)
+        assert out == [True, False]
+        s = cache.stats()
+        assert s["device_decided"] == 2 and s["queries"] == 2
+        # next round: both answered from the memo, no dispatch
+        out2 = cache.decide_batch([sat_set, unsat_set], flips=64)
+        assert out2 == [True, False] and cache.stats()["hits_exact"] == 2
+
+
+# ---------------------------------------------------------------------------
+# cross-job memo export (service/cache.py seam)
+# ---------------------------------------------------------------------------
+
+
+class TestMemoExport:
+    def test_export_seed_roundtrip_across_caches(self):
+        donor = sc.SolverCache()
+        corpus = formulas("xpa", 71, count=4)
+        for fs in corpus:
+            donor.record(fs, fresh_host_verdict(fs))
+        memo = donor.export_memo()
+        assert memo  # alpha entries exist for every decided set
+        fresh = sc.SolverCache()
+        fresh.seed_memo(memo)
+        renamed = formulas("xpb", 71, count=4)
+        for fs in renamed:
+            code, _ = fresh.lookup(fs)
+            assert code == fresh_host_verdict(fs)
+        assert fresh.stats()["hits_alpha"] == len(renamed)
+
+    def test_result_cache_memo_merges_and_bounds(self):
+        rc = ResultCache()
+        rc.solver_memo_max = 2
+        rc.put_solver_memo(b"k1", {b"d1": sc.SAT})
+        rc.put_solver_memo(b"k1", {b"d2": sc.UNSAT})
+        assert rc.get_solver_memo(b"k1") == {b"d1": sc.SAT, b"d2": sc.UNSAT}
+        # returned memo is a copy, not the live table
+        rc.get_solver_memo(b"k1")[b"poison"] = sc.SAT
+        assert b"poison" not in rc.get_solver_memo(b"k1")
+        rc.put_solver_memo(b"k2", {b"d3": sc.SAT})
+        rc.put_solver_memo(b"k3", {b"d4": sc.SAT})  # evicts the LRU key
+        assert rc.get_solver_memo(b"k2") is not None
+        assert rc.get_solver_memo(b"k3") is not None
+        assert rc.get_solver_memo(b"k1") is None
+
+
+# ---------------------------------------------------------------------------
+# path-prefix fingerprints (symtape seam)
+# ---------------------------------------------------------------------------
+
+
+class TestPathFingerprint:
+    def test_shared_prefix_identical_order_sensitive(self):
+        h1 = np.array([11, 22, 33, 44], dtype=np.uint64)
+        h2 = np.array([55, 66, 77, 88], dtype=np.uint64)
+        signs = np.array([1, 0, 1, 1], dtype=np.uint64)
+        fps = symtape.path_fingerprint(h1, h2, signs)
+        assert fps.shape == (4,) and len(set(fps.tolist())) == 4
+        # a forked sibling shares the parent tape: identical prefix fps
+        sib = symtape.path_fingerprint(h1[:3], h2[:3], signs[:3])
+        assert sib.tolist() == fps[:3].tolist()
+        # order matters: swapping two constraints changes the chain
+        perm = symtape.path_fingerprint(h1[::-1], h2[::-1], signs[::-1])
+        assert perm[-1] != fps[-1]

@@ -4,10 +4,14 @@ Regression pin for the frontier feasibility triage
 (laser/tpu/backend.py filter_feasible): when the batched device solver
 cannot decide an instance — CNF blasting exceeds the kernel caps
 (solver_jax.CapExceeded -> verdict None), the search budget runs out, or
-the dispatch itself fails — the lane must fall through to the host Z3
-path, never be treated as infeasible. Dropping undecided-but-satisfiable
-states would silently truncate exploration (missed detections), which is
-exactly the failure mode these tests make loud.
+the dispatch itself fails — the lane must survive the round (unknown
+counts as possible; settlement re-solves authoritatively, and in
+service mode the async pool folds a late verdict into the memo), never
+be treated as infeasible. Dropping undecided-but-satisfiable states
+would silently truncate exploration (missed detections), which is
+exactly the failure mode these tests make loud. When the device is NOT
+available (pre-warmup / sub-floor frontier), the inline quick host
+check is the only pruner and must still decide the frontier.
 """
 
 from types import SimpleNamespace
@@ -56,19 +60,22 @@ def test_cap_exceeded_blast_returns_undecided(monkeypatch):
     assert verdicts == [None]
 
 
-def test_undecided_verdicts_fall_back_to_host(monkeypatch, device_engaged):
+def test_undecided_verdicts_survive_optimistically(monkeypatch, device_engaged):
     sat, unsat = _frontier()
     monkeypatch.setattr(
         solver_jax, "feasibility_batch", lambda sets, **kw: [None] * len(sets)
     )
     survivors = backend.filter_feasible([sat, unsat])
-    # the host solver decided both: the satisfiable lane survives
-    assert survivors == [sat]
+    # device residue is never host-checked on the round loop's critical
+    # path: both lanes survive the round as possible (settlement
+    # re-solves authoritatively before anything is reported), and
+    # crucially neither is marked infeasible
+    assert survivors == [sat, unsat]
     assert sat.world_state.constraints._is_possible is True
-    assert unsat.world_state.constraints._is_possible is False
+    assert unsat.world_state.constraints._is_possible is True
 
 
-def test_dispatch_failure_falls_back_to_host(monkeypatch, device_engaged):
+def test_dispatch_failure_survives_optimistically(monkeypatch, device_engaged):
     sat, unsat = _frontier()
 
     def boom(sets, **kw):
@@ -76,7 +83,19 @@ def test_dispatch_failure_falls_back_to_host(monkeypatch, device_engaged):
 
     monkeypatch.setattr(solver_jax, "feasibility_batch", boom)
     survivors = backend.filter_feasible([sat, unsat])
+    assert survivors == [sat, unsat]
+
+
+def test_host_decides_when_device_unavailable(monkeypatch):
+    # below the warmup / dispatch floor the device never runs; the
+    # inline quick host check is the only pruner and must decide the
+    # frontier rather than wave everything through
+    monkeypatch.setattr(backend, "_warmup_done", False)
+    sat, unsat = _frontier()
+    survivors = backend.filter_feasible([sat, unsat])
     assert survivors == [sat]
+    assert sat.world_state.constraints._is_possible is True
+    assert unsat.world_state.constraints._is_possible is False
 
 
 def test_device_verdicts_are_seeded_when_decided(monkeypatch, device_engaged):

@@ -105,3 +105,95 @@ class TestDeviceSolverCrossCheck:
         )
         assert out[0] is True
         assert out[1] is False
+
+
+def _forked_family(rng, n):
+    """Append-only constraint lists sharing prefixes, like a frontier of
+    forked sibling lanes (plus occasional contradictions)."""
+    base = [(bv("fam_a") + val(3) == bv("fam_b"))]
+    fam = []
+    for _ in range(n):
+        cs = list(base)
+        for _d in range(rng.randrange(0, 5)):
+            x = bv("fam_v%d" % rng.randrange(4))
+            k = val(rng.randrange(1, 1 << W))
+            cs.append(
+                rng.choice(
+                    [x == k, ULT(x, k), x + k == bv("fam_w%d" % rng.randrange(3))]
+                )
+            )
+        if rng.random() < 0.3:
+            cs.append(bv("fam_z") == val(1))
+            cs.append(bv("fam_z") == val(2))
+        fam.append([c.raw for c in cs])
+        if rng.random() < 0.5:
+            base = [c for c in cs[: rng.randrange(1, len(cs) + 1)]]
+    return fam
+
+
+class TestBlastTrie:
+    """compile_cnf_batch: shared-prefix incremental blasting must be
+    observationally identical to the per-set compile_cnf path."""
+
+    def test_batch_matches_per_set_compile(self):
+        rng = random.Random(77)
+        fam = _forked_family(rng, 32)
+        batch = sj.compile_cnf_batch(fam)
+        single = [sj.compile_cnf(cs) for cs in fam]
+        for i, (b, s) in enumerate(zip(batch, single)):
+            assert (b is None) == (s is None), i
+            if b is None:
+                continue
+            assert b.trivial == s.trivial, i
+            if b.trivial is None:
+                # numbering is private per compile; the observable
+                # surface is the named-symbol bridge and non-emptiness
+                assert set(b.var_bits) == set(s.var_bits), i
+                assert set(b.bool_vars) == set(s.bool_vars), i
+                assert b.clause_arr.shape[0] > 0
+
+    def test_batch_verdicts_match_host(self):
+        from mythril_tpu.laser.tpu import solver_cache as sc
+        from mythril_tpu.smt.solver.incremental import IncrementalCore
+
+        rng = random.Random(78)
+        fam = _forked_family(rng, 24)
+        verdicts = sj.check_batch(fam, flips=256)
+        for cs_raw, verdict in zip(fam, verdicts):
+            if verdict == sj.UNKNOWN:
+                continue
+            host = sc._host_check(cs_raw, 10_000, core=IncrementalCore())
+            assert host == verdict, cs_raw
+
+    def test_oversized_set_does_not_poison_siblings(self):
+        # a sibling that blows the caps mid-trie must roll back cleanly:
+        # the next set (sharing the prefix) still compiles and solves
+        a256 = symbol_factory.BitVecSym("trie_cap_a", 256)
+        b256 = symbol_factory.BitVecSym("trie_cap_b", 256)
+        prefix = (bv("trie_p") == val(5)).raw
+        big = UGT(a256 * b256, a256).raw
+        fam = [
+            [prefix, big],
+            [prefix, (bv("trie_q") == val(7)).raw],
+            [prefix, (bv("trie_q") == val(7)).raw, (bv("trie_q") == val(8)).raw],
+        ]
+        out = sj.compile_cnf_batch(fam, max_vars=512, max_clauses=512)
+        assert out[0] is None
+        assert out[1] is not None and out[1].trivial is None
+        assert out[2] is not None
+        res = sj.check_batch(
+            fam[1:], flips=128, max_vars=512, max_clauses=512
+        )
+        assert res == [sj.SAT, sj.UNSAT]
+
+    def test_failed_prefix_skips_extensions(self):
+        # every extension of a capped prefix is rejected without
+        # re-blasting (and without touching surviving siblings)
+        a256 = symbol_factory.BitVecSym("trie_skip_a", 256)
+        b256 = symbol_factory.BitVecSym("trie_skip_b", 256)
+        big = UGT(a256 * b256, a256).raw
+        small = (bv("trie_s") == val(1)).raw
+        fam = [[big], [big, small], [small]]
+        out = sj.compile_cnf_batch(fam, max_vars=512, max_clauses=512)
+        assert out[0] is None and out[1] is None
+        assert out[2] is not None
