@@ -476,6 +476,14 @@ def _service_bench() -> int:
         max_resident_jobs=stats["max_resident_jobs"],
         shared_rounds=stats["shared_rounds"],
         rounds=stats["rounds"],
+        # robustness overhead trajectory (docs/ROBUSTNESS.md): all four
+        # must stay ~0 on a clean run — nonzero device_retries or
+        # degraded_rounds on healthy hardware means the watchdog is
+        # misfiring, and checkpoint_overhead_s bounds the journal cost
+        device_retries=stats["device_retries"],
+        degraded_rounds=stats["degraded_rounds"],
+        checkpoint_overhead_s=round(stats["checkpoint_overhead_s"], 3),
+        quarantined_jobs=stats["quarantined_jobs"],
     )
     _checkpoint(progress)
     assert len(done) == len(workload), "jobs failed: %r" % statuses

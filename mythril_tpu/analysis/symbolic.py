@@ -96,6 +96,7 @@ class SymExecWrapper:
         checkpoint_dir: Optional[str] = None,
         pre_exec_hook=None,
         fresh_solver_core: bool = True,
+        resume_from=None,
     ):
         # every analysis starts from a fresh incremental solver core:
         # clause-database growth from prior contracts/runs in the same
@@ -176,6 +177,10 @@ class SymExecWrapper:
         # before execution, e.g. to install a SteadyStateMeter
         if pre_exec_hook is not None:
             pre_exec_hook(self.laser)
+        # ``resume_from`` (a robustness.checkpoint.FrontierCheckpoint)
+        # replaces the creation transaction and the already-completed
+        # message-call rounds with the journaled frontier
+        self._resume_from = resume_from
         self._execute(contract, address, world_state, dynloader)
 
         if requires_statespace:
@@ -186,6 +191,14 @@ class SymExecWrapper:
     # -- execution ------------------------------------------------------------
 
     def _execute(self, contract, address, world_state, dynloader) -> None:
+        ckpt = self._resume_from
+        if ckpt is not None:
+            self.laser.sym_exec_resume(
+                ckpt.restore(),
+                ckpt.address,
+                rounds_done=ckpt.rounds_done,
+            )
+            return
         if getattr(contract, "creation_code", None):
             self.laser.sym_exec(
                 creation_code=contract.creation_code,

@@ -44,6 +44,7 @@ from mythril_tpu.laser.evm.plugins.signals import PluginSkipState
 from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
 from mythril_tpu.laser.tpu.engine import run, run_with_stats
 from mythril_tpu.laser.tpu import solver_cache, solver_jax, symtape, transfer
+from mythril_tpu.robustness import retry as _retry
 from mythril_tpu.support.opcodes import OPCODES
 
 log = logging.getLogger(__name__)
@@ -122,6 +123,11 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # destination enters a static must-revert block (engine.py
         # prune_child; bench protocol field static_pruned_lanes)
         self.static_pruned_lanes = 0
+        # robustness ladder accounting (bench protocol fields): extra
+        # device-round attempts, and rounds that gave up on the device
+        # and continued their packed states on the host path
+        self.device_retries = 0
+        self.degraded_rounds = 0
         # solver-cache accounting baseline: the cache is process-global
         # (verdicts legitimately outlive one analysis), so per-analysis
         # counters are deltas against the construction-time snapshot
@@ -749,7 +755,14 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
         # modest search budget: this is triage — propagation decides the
         # common selector/guard conditions instantly, and anything the
         # budget leaves open survives the round as possible
-        use_device = bool(_warmup_done) and len(undecided) >= MIN_DEVICE_SOLVE_BATCH
+        # passive breaker read (not allow()): the half-open trial slot
+        # belongs to the device ROUND path; solver dispatch stays off
+        # until a trial round succeeds and closes the breaker
+        use_device = (
+            bool(_warmup_done)
+            and len(undecided) >= MIN_DEVICE_SOLVE_BATCH
+            and not _retry.BREAKER.open
+        )
         sets = [
             [c.raw for c in s.world_state.constraints] for s in undecided
         ]
@@ -831,7 +844,8 @@ def _triage_lazy_screens(states: List[GlobalState]) -> None:
         for state in states:
             try:
                 requests = collect(state, skip)
-            except Exception:  # pragma: no cover - prescreen best-effort
+            except Exception as e:  # pragma: no cover - prescreen best-effort
+                log.debug("prescreen collect failed: %s", e)
                 continue
             for token, constraints in requests:
                 prescreen.append((module, token, constraints))
@@ -1047,6 +1061,13 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         if len(survivors) < cfg.min_device_frontier or not engaged:
             laser.work_list.extend(survivors)
             continue
+        if job_ctx is None and _retry.BREAKER.state() == "open":
+            # circuit open (cooldown running): the device is considered
+            # down — this round continues host-only. The shared-round
+            # path makes the same call inside the lane coordinator.
+            laser.work_list.extend(survivors)
+            strategy.degraded_rounds += 1
+            continue
         to_pack = survivors[:seed_cap]
         overflow = survivors[seed_cap:]
         laser.work_list.extend(overflow)
@@ -1073,6 +1094,13 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 return final_states + laser.work_list[:] if track_gas else None
             laser.work_list.extend(res.failed)
             packed_states = res.packed
+            strategy.device_retries += res.retries
+            if res.degraded:
+                # the shared round gave up on the device; every state is
+                # back in res.failed and continues on the host path
+                strategy.degraded_rounds += 1
+                if res.oom:
+                    seed_cap = max(1, seed_cap // 2)
             if res.out is None or not packed_states:
                 continue
             bridge = res.bridge
@@ -1106,24 +1134,35 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             if not packed_states:
                 continue
 
-            cb, st = bridge.finish()
-            round_start = time.time()
-            out, op_hist = _run_device(
-                cb,
-                st,
-                cfg,
-                want_stats=want_stats,
-                deadline=budget_deadline,
-                bridge=bridge,
-            )
-            # device wall captured NOW: _run_device's quiescence fetches
-            # have synced the final slice, and the download/dict-building
-            # below is host transport cost that must not inflate the
-            # device section (advisor r3)
-            device_wall = time.time() - round_start
-            # one download: everything below (step counters, coverage
-            # merge, per-lane unpack/lift) reads the host view for free
-            out = transfer.batch_to_host(out)
+            if not _retry.BREAKER.allow():
+                # raced into an open/claimed breaker after packing: the
+                # staged states are untouched host-side, continue them
+                laser.work_list.extend(packed_states)
+                strategy.degraded_rounds += 1
+                continue
+            try:
+                # guarded round: retries with backoff inside (counted on
+                # strategy.device_retries), breaker bookkeeping, and the
+                # device wall covering only the stepping loop (advisor
+                # r3: the download is host transport cost)
+                out, op_hist, device_wall = _retry.run_round_guarded(
+                    bridge,
+                    cfg,
+                    want_stats=want_stats,
+                    deadline=budget_deadline,
+                    counters=strategy,
+                )
+            except _retry.DeviceRoundError as e:
+                # degrade, never die: the staged states still exist on
+                # the host side — put them back and keep executing.
+                # An OOM additionally halves the pack cap (ladder step
+                # 2): the next round asks the device for less.
+                log.warning("device round degraded to host path: %s", e)
+                strategy.degraded_rounds += 1
+                laser.work_list.extend(packed_states)
+                if e.oom:
+                    seed_cap = max(1, seed_cap // 2)
+                continue
             job_mask = None
         if op_hist is not None and laser.iprof is not None:
             hist = np.asarray(op_hist)

@@ -256,9 +256,15 @@ class DeviceBridge:
         return lane
 
     def finish(self) -> Tuple[CodeBank, StateBatch]:
-        """Freeze the staged lanes into device arrays (one upload)."""
-        from mythril_tpu.laser.tpu import transfer
+        """Freeze the staged lanes into device arrays (one upload).
 
+        Re-runnable: the staged numpy batch is kept, so a retried round
+        (robustness/retry.py) re-enters here and re-uploads the same
+        lanes after a transfer fault."""
+        from mythril_tpu.laser.tpu import transfer
+        from mythril_tpu.robustness import faults
+
+        faults.fire(faults.TRANSFER_UP, context="bridge.finish")
         if self._np_batch is None or self._n_staged == 0:
             raise PackError("nothing staged")
         cb = make_code_bank(

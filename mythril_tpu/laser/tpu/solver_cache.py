@@ -55,6 +55,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from mythril_tpu.robustness import faults
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.solver import pysat
 from mythril_tpu.smt.solver.bitblast import BlastError
@@ -255,6 +256,7 @@ def _host_check(
     ``core=None`` uses the process-global incremental core (single-
     threaded callers only: service invariant I2). Pool workers pass
     their private per-thread core."""
+    faults.fire(faults.HOST_SOLVE)
     if any(t is terms.FALSE for t in raw_terms):
         return UNSAT
     concrete = [t for t in raw_terms if t is not terms.TRUE]
@@ -347,6 +349,7 @@ class FallbackPool:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._threads: List[threading.Thread] = []
+        self._spawned = 0  # lifetime spawn count (thread names, tests)
         self._tls = threading.local()
         # p95 source: in-flight depth sampled at every submit/complete
         self._inflight_samples: "deque[int]" = deque(maxlen=1024)
@@ -373,13 +376,15 @@ class FallbackPool:
         return True
 
     def _ensure_threads(self) -> None:
+        """Keep the worker complement full: prune dead threads (a worker
+        CAN die — injected or real) and respawn up to ``workers``."""
         with self._lock:
-            if self._threads:
-                return
-            for i in range(self.workers):
+            self._threads = [t for t in self._threads if t.is_alive()]
+            while len(self._threads) < self.workers:
+                self._spawned += 1
                 t = threading.Thread(
                     target=self._worker_loop,
-                    name="solver-fallback-%d" % i,
+                    name="solver-fallback-%d" % self._spawned,
                     daemon=True,
                 )
                 self._threads.append(t)
@@ -405,13 +410,19 @@ class FallbackPool:
                 return False
             job = self._queue.popleft()
         try:
+            # the worker-death seam fires INSIDE the try: the in-flight
+            # key is released by the finally either way, so the dropped
+            # query can be resubmitted to a surviving/respawned worker
+            faults.fire(faults.FALLBACK_WORKER)
             if job.dead():
                 self.cache._count("async_dropped")
                 return True
             t0 = time.monotonic()
             try:
                 code = _host_check(job.raw_terms, self.timeout_ms, self._core())
-            except Exception as e:  # pragma: no cover - worker never dies
+            except Exception as e:
+                # a faulted solve settles as UNKNOWN and records NOTHING
+                # (code below): the memo must never remember a failure
                 log.warning("fallback solve failed: %s", e)
                 code = UNKNOWN
             self.cache._add_time(time.monotonic() - t0)
@@ -424,9 +435,17 @@ class FallbackPool:
                 self._inflight_samples.append(len(self._inflight_keys))
         return True
 
-    def _worker_loop(self) -> None:  # pragma: no cover - timing-dependent
+    def _worker_loop(self) -> None:
         while True:
-            self.process_once(block=True)
+            try:
+                self.process_once(block=True)
+            except faults.WorkerDeath as e:
+                # a dead worker does not keep polling: exit the thread;
+                # the next submit()'s _ensure_threads respawns the slot
+                log.warning("fallback worker exiting: %s", e)
+                return
+            except Exception as e:  # pragma: no cover - defensive
+                log.warning("fallback worker error (continuing): %s", e)
 
     def drain(self, timeout: float = 10.0) -> None:
         """Block until the queue and in-flight set are empty (tests,
@@ -688,6 +707,12 @@ class SolverCache:
             # cached UNKNOWN: stay None, but do NOT re-solve (the whole
             # point: this set already exhausted both budgets)
 
+        # device_ok distinguishes "the device ran and left residue"
+        # (optimistic + async is correct) from "the dispatch FAILED"
+        # (the residue was never solved: degrade to the inline host
+        # path, and above all write no UNKNOWN memos for it — a fault
+        # is not an exhausted budget)
+        device_ok = True
         if use_device and pending:
             sub = [sets[i] for i in pending]
             warm = None
@@ -704,9 +729,11 @@ class SolverCache:
                     out = solver_jax.feasibility_batch(sub, flips=flips)
                 except Exception as e:  # pragma: no cover - device degrade
                     log.warning("device feasibility batch failed: %s", e)
+                    device_ok = False
                     out = [None] * len(sub)
             except Exception as e:
                 log.warning("device feasibility batch failed: %s", e)
+                device_ok = False
                 out = [None] * len(sub)
             if isinstance(out, tuple):
                 dev_verdicts, dev_models = out
@@ -736,7 +763,7 @@ class SolverCache:
             deadline, cancel_event = _job_context()
             pool_armed = self._pool_armed(cancel_event, deadline)
             for i in pending:
-                if use_device:
+                if use_device and device_ok:
                     # device residue: optimistic + async (see docstring)
                     self._count("unknown")
                     self.record(sets[i], UNKNOWN, key=keys[i])
@@ -748,7 +775,14 @@ class SolverCache:
                             cancel_event=cancel_event,
                         )
                     continue
-                code = _host_check(sets[i], HOST_BUDGET_MS)
+                try:
+                    code = _host_check(sets[i], HOST_BUDGET_MS)
+                except Exception as e:
+                    # faulted host check: stay optimistic (None verdict)
+                    # and record NOTHING — no UNKNOWN memo may remember
+                    # a failure as if both budgets had been spent
+                    log.warning("host check failed (no memo written): %s", e)
+                    continue
                 if code == SAT:
                     verdicts[i] = True
                     self._count("host_decided")

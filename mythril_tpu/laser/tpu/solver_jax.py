@@ -677,6 +677,9 @@ def check_batch(
     ``(codes, [model-or-None])``. Instances are grouped onto the fixed
     (vars, clauses) pad ladder so jit specializations stay bounded.
     """
+    from mythril_tpu.robustness import faults
+
+    faults.fire(faults.SOLVER_BATCH, context="check_batch")
     n = len(constraint_sets)
     results = [UNKNOWN] * n
     models_out: List[Optional[dict]] = [None] * n

@@ -19,6 +19,7 @@ TPU/CPU XLA), which the round-trip test pins down.
 
 from functools import partial
 
+import logging
 import os
 
 import jax
@@ -26,8 +27,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from mythril_tpu.laser.tpu import words
+from mythril_tpu.robustness import faults
 
 from mythril_tpu.laser.tpu.batch import StateBatch, batch_shapes
+
+log = logging.getLogger(__name__)
 
 # planes the host-side consumers (bridge lift/unpack, coverage merge,
 # checkpointing) never read — skipped on the way down to save bytes;
@@ -74,11 +78,12 @@ def monomorphic() -> bool:
             import jax
 
             _MONO.append(jax.devices()[0].platform != "cpu")
-        except Exception:
+        except Exception as e:
             # do NOT memoize the failure: a transient backend hiccup at
             # init (tunnel blip) must not pin an accelerator process to
             # the polymorphic path — and its minutes-long per-bucket
             # recompiles — forever
+            log.debug("device probe failed, assuming cpu for now: %s", e)
             return False
     return _MONO[0]
 
@@ -175,6 +180,7 @@ def batch_to_device(np_batch: dict, cfg) -> StateBatch:
     packed batch is mostly zeros, so the wire payload is typically a few
     hundred KB instead of the full batch.
     """
+    faults.fire(faults.TRANSFER_UP, context="batch_to_device")
     shapes = batch_shapes(cfg)
     if monomorphic():
         t_used = cfg.tape_slots
@@ -272,6 +278,7 @@ def batch_to_host(st: StateBatch) -> StateBatch:
     everything downstream of a device round (lift/unpack, coverage, step
     counters) reads this view without further transfers.
     """
+    faults.fire(faults.TRANSFER_DOWN, context="batch_to_host")
     small = tuple(
         f
         for f in StateBatch._fields

@@ -63,6 +63,22 @@ def handle_request(service: AnalysisService, request: Dict) -> Dict:
             return {"ok": True, "cancelled": service.cancel(int(request["job_id"]))}
         if op == "stats":
             return {"ok": True, **service.stats()}
+        if op == "health":
+            # one-glance liveness for operators/load balancers: breaker
+            # posture, degraded-round pressure, and quarantine count
+            from mythril_tpu.robustness import retry
+
+            stats = service.stats()
+            return {
+                "ok": True,
+                "healthy": retry.BREAKER.state() == "closed",
+                "breaker_state": stats["breaker_state"],
+                "breaker_trips": stats["breaker_trips"],
+                "device_retries": stats["device_retries"],
+                "degraded_rounds": stats["degraded_rounds"],
+                "quarantined_jobs": stats["quarantined_jobs"],
+                "checkpoint_overhead_s": stats["checkpoint_overhead_s"],
+            }
         if op == "shutdown":
             return {"ok": True, "shutdown": True}
         return {"ok": False, "kind": "bad-request", "error": "unknown op %r" % op}

@@ -32,6 +32,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from mythril_tpu.support.keccak import keccak256
 
+# crash strikes before a code hash is quarantined. Two, deliberately:
+# the scheduler retries a crashed job exactly once (from its last
+# frontier checkpoint), so a deterministically-poisonous contract
+# collects both strikes on its FIRST submission and every later
+# submission is rejected at admission.
+QUARANTINE_AFTER = 2
+
 
 def cache_key(creation_hex: str, runtime_hex: str) -> bytes:
     """keccak256 over the exact submitted code bytes."""
@@ -82,6 +89,14 @@ class ResultCache:
         self.solver_memo_max = 128
         self.hits = 0
         self.misses = 0
+        # poison-job quarantine: code hash -> crash strike count, and
+        # the structured report of the LAST crash (admission rejections
+        # cite it). Strikes are per FAILED ATTEMPT, cleared by any
+        # successful run — transient device/solver faults the ladder
+        # absorbed never accumulate into a quarantine.
+        self._crash_strikes: Dict[bytes, int] = {}
+        self._crash_reports: Dict[bytes, Dict[str, Any]] = {}
+        self._quarantined: Dict[bytes, str] = {}
 
     def get(
         self,
@@ -156,6 +171,52 @@ class ResultCache:
             while len(self._solver_memos) > self.solver_memo_max:
                 self._solver_memos.popitem(last=False)
 
+    # -- poison-job quarantine ------------------------------------------
+
+    def record_crash(self, key: bytes, report: Optional[Dict[str, Any]] = None) -> int:
+        """One crashed attempt for this code hash; returns the new
+        strike count. The ``QUARANTINE_AFTER``-th strike quarantines the
+        hash: later submissions are rejected at admission."""
+        with self._lock:
+            strikes = self._crash_strikes.get(key, 0) + 1
+            self._crash_strikes[key] = strikes
+            if report:
+                self._crash_reports[key] = dict(report)
+            if strikes >= QUARANTINE_AFTER and key not in self._quarantined:
+                report = self._crash_reports.get(key) or {}
+                self._quarantined[key] = (
+                    "crashed %d times (last: %s at seam %s, round %s)" % (
+                        strikes,
+                        report.get("exception", "unknown exception"),
+                        report.get("seam") or "?",
+                        report.get("round", "?"),
+                    )
+                )
+            return strikes
+
+    def record_success(self, key: bytes) -> None:
+        """A completed run clears the hash's strikes (and any quarantine
+        an operator lifted manually stays lifted)."""
+        with self._lock:
+            self._crash_strikes.pop(key, None)
+            self._crash_reports.pop(key, None)
+
+    def is_quarantined(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._quarantined
+
+    def quarantine_reason(self, key: bytes) -> Optional[str]:
+        with self._lock:
+            return self._quarantined.get(key)
+
+    def lift_quarantine(self, key: bytes) -> bool:
+        """Operator override: re-admit a quarantined hash (strikes reset
+        so it gets a fresh two attempts)."""
+        with self._lock:
+            self._crash_strikes.pop(key, None)
+            self._crash_reports.pop(key, None)
+            return self._quarantined.pop(key, None) is not None
+
     @staticmethod
     def _reseed_static_pass(tables) -> None:
         """Re-insert the held static-pass tables into the pass's own LRU
@@ -172,6 +233,7 @@ class ResultCache:
                 "entries": len(self._entries),
                 "hits": self.hits,
                 "misses": self.misses,
+                "quarantined": len(self._quarantined),
             }
 
     def __len__(self) -> int:

@@ -74,7 +74,8 @@ class JobContext:
 class RoundResult:
     """What one participant gets back from a shared round."""
 
-    def __init__(self, out, bridge, packed, failed, device_wall: float):
+    def __init__(self, out, bridge, packed, failed, device_wall: float,
+                 degraded: bool = False, retries: int = 0, oom: bool = False):
         # host-side StateBatch of the WHOLE merged round; callers mask
         # their lanes with ``out.job_id == their job id``
         self.out = out
@@ -82,6 +83,11 @@ class RoundResult:
         self.packed = packed  # states that made it into a lane
         self.failed = failed  # states that did not (PackError / overflow)
         self.device_wall = device_wall
+        # robustness ladder attribution (every participant of a round
+        # shares these: each experienced the retry delay / the degrade)
+        self.degraded = degraded
+        self.retries = retries
+        self.oom = oom
 
 
 class _RoundRequest:
@@ -127,6 +133,9 @@ class LaneCoordinator:
         self.max_resident_jobs = 0
         self.rounds = 0
         self.shared_rounds = 0
+        # service-wide robustness ladder aggregates (bench fields)
+        self.device_retries = 0
+        self.degraded_rounds = 0
         # per-job storage-ring drain counts for the current bridge epoch
         self.ss_drains_by_job: Dict[int, int] = {}
 
@@ -227,15 +236,24 @@ class LaneCoordinator:
         return batch
 
     def _lead_round(self) -> None:
-        from mythril_tpu.laser.tpu import transfer
-        from mythril_tpu.laser.tpu import backend
         from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
+        from mythril_tpu.robustness import retry
 
         leader = self._leader
         batch = self._gather(leader)
         if not batch:
             return
         try:
+            if not retry.BREAKER.allow():
+                # circuit open: the device is considered down. Every
+                # participant degrades on the spot — all states come
+                # back via ``failed`` and continue on the host path.
+                self.degraded_rounds += 1
+                for req in batch:
+                    req.result = RoundResult(
+                        None, None, [], list(req.states), 0.0, degraded=True
+                    )
+                return
             # merged round parameters: union/AND/MIN across participants
             host_ops = set()
             tape_replayers: dict = {}
@@ -288,20 +306,37 @@ class LaneCoordinator:
                             None, bridge, req.packed, req.failed, 0.0
                         )
                     return
-                cb, st = bridge.finish()
             finally:
                 self.host_lock.release()
 
             # the device round itself runs WITHOUT the host lock (I3):
             # jobs still in their host phase keep making progress and
-            # can queue for the next round meanwhile
-            round_start = time.time()
-            out, _hist = backend._run_device(
-                cb, st, self.cfg, want_stats=False,
-                deadline=deadline, bridge=bridge,
-            )
-            device_wall = time.time() - round_start
-            out = transfer.batch_to_host(out)
+            # can queue for the next round meanwhile. The guard retries
+            # with backoff, keeps the breaker honest, and re-enters
+            # bridge.finish() itself (re-runnable: staged numpy batch).
+            counters = retry.RoundCounters()
+            try:
+                out, _hist, device_wall = retry.run_round_guarded(
+                    bridge, self.cfg, want_stats=False,
+                    deadline=deadline, counters=counters,
+                )
+            except retry.DeviceRoundError as e:
+                # shared round degrades for every participant: packed
+                # states move back through ``failed`` so each job's
+                # exec_batch puts them on its own work list (same
+                # put-back as a pack failure — nothing is dropped)
+                log.warning("shared device round degraded to host: %s", e)
+                self.degraded_rounds += 1
+                self.device_retries += counters.device_retries
+                for req in batch:
+                    req.result = RoundResult(
+                        None, bridge, [], req.failed + req.packed, 0.0,
+                        degraded=True, retries=counters.device_retries,
+                        oom=e.oom,
+                    )
+                    req.packed = []
+                return
+            self.device_retries += counters.device_retries
 
             resident = np.unique(
                 np.asarray(out.job_id)[np.asarray(out.alive)]
@@ -315,7 +350,8 @@ class LaneCoordinator:
             )
             for req in batch:
                 req.result = RoundResult(
-                    out, bridge, req.packed, req.failed, device_wall
+                    out, bridge, req.packed, req.failed, device_wall,
+                    retries=counters.device_retries,
                 )
         except BaseException as e:  # pragma: no cover - round failure
             for req in batch:

@@ -44,7 +44,9 @@ from collections import deque
 from enum import Enum
 from typing import Dict, List, Optional
 
-from mythril_tpu.service.cache import ResultCache, cache_key
+from mythril_tpu.robustness import faults
+from mythril_tpu.robustness.checkpoint import CheckpointJournal
+from mythril_tpu.service.cache import QUARANTINE_AFTER, ResultCache, cache_key
 from mythril_tpu.service.lanes import (
     DEFAULT_GATHER_WINDOW_S,
     JobContext,
@@ -108,8 +110,17 @@ class AnalysisJob:
         self.cache_hit = False
         self.result: Optional[Dict] = None
         self.error: Optional[str] = None
+        # structured crash classification (exception class, seam, round
+        # number, attempt) for FAILED jobs — the quarantine cites it
+        self.error_report: Optional[Dict] = None
+        # robustness ladder attribution, summed across attempts
+        self.degraded = False
+        self.retried = False
+        self.device_retries = 0
+        self.degraded_rounds = 0
         self.cancel_event = threading.Event()
         self.done_event = threading.Event()
+        self._finish_lock = threading.Lock()
 
     @property
     def internal_name(self) -> str:
@@ -117,12 +128,20 @@ class AnalysisJob:
         singleton detection modules' state splits exactly at harvest."""
         return "%s#%d" % (self.name, self.id)
 
-    def finish(self, state: JobState) -> None:
-        self.state = state
-        self.finished_at = time.time()
-        if self.started_at is not None:
-            self.wall_s = self.finished_at - self.started_at
-        self.done_event.set()
+    def finish(self, state: JobState) -> bool:
+        """Terminal transition; idempotent. Returns True only for the
+        ONE caller that actually finished the job — shutdown marking a
+        wedged job FAILED can race its worker's own finalize, and
+        exactly one of them may update the service counters."""
+        with self._finish_lock:
+            if self.done_event.is_set():
+                return False
+            self.state = state
+            self.finished_at = time.time()
+            if self.started_at is not None:
+                self.wall_s = self.finished_at - self.started_at
+            self.done_event.set()
+            return True
 
     def status_dict(self) -> Dict:
         return {
@@ -132,6 +151,11 @@ class AnalysisJob:
             "cache_hit": self.cache_hit,
             "wall_s": self.wall_s,
             "error": self.error,
+            "error_report": self.error_report,
+            "degraded": self.degraded,
+            "retried": self.retried,
+            "device_retries": self.device_retries,
+            "degraded_rounds": self.degraded_rounds,
         }
 
 
@@ -173,6 +197,9 @@ class AnalysisService:
             batch_cfg, self.host_lock, gather_window_s=gather_window_s
         )
         self.cache = ResultCache(max_entries=cache_entries)
+        # frontier checkpoints (keyed by job id): a FAILED job's one
+        # retry resumes from its latest journaled frontier
+        self.journal = CheckpointJournal()
         self.queue_size = queue_size
         self._queue: "deque[AnalysisJob]" = deque()
         self._queue_cv = threading.Condition(threading.Lock())
@@ -183,6 +210,7 @@ class AnalysisService:
         self.jobs_done = 0
         self.jobs_failed = 0
         self.jobs_cancelled = 0
+        self.jobs_retried = 0
         self._workers = [
             threading.Thread(
                 target=self._worker, name="analysis-worker-%d" % i, daemon=True
@@ -224,6 +252,11 @@ class AnalysisService:
             raise AdmissionError("tx_count must be >= 1")
         if timeout is not None and timeout <= 0:
             raise AdmissionError("timeout must be positive")
+        reason = self.cache.quarantine_reason(
+            cache_key(creation_hex, runtime_hex)
+        )
+        if reason is not None:
+            raise AdmissionError("code hash is quarantined: %s" % reason)
 
         job = AnalysisJob(
             next(self._ids), name, runtime_hex, creation_hex,
@@ -284,25 +317,63 @@ class AnalysisService:
         return True
 
     def stats(self) -> Dict:
+        from mythril_tpu.robustness import retry
+
+        ckpt = self.journal.stats()
         return {
             "jobs_submitted": self.jobs_submitted,
             "jobs_done": self.jobs_done,
             "jobs_failed": self.jobs_failed,
             "jobs_cancelled": self.jobs_cancelled,
+            "jobs_retried": self.jobs_retried,
             "queued": len(self._queue),
             "rounds": self.coordinator.rounds,
             "shared_rounds": self.coordinator.shared_rounds,
             "max_resident_jobs": self.coordinator.max_resident_jobs,
+            "device_retries": self.coordinator.device_retries,
+            "degraded_rounds": self.coordinator.degraded_rounds,
+            "breaker_state": retry.BREAKER.state(),
+            "breaker_trips": retry.BREAKER.trips,
+            "checkpoint_overhead_s": ckpt["overhead_s"],
+            "checkpoints": ckpt["snapshots"],
+            "quarantined_jobs": self.cache.stats()["quarantined"],
             "cache": self.cache.stats(),
         }
 
     def shutdown(self, wait: bool = True, timeout: Optional[float] = 30) -> None:
+        """Stop the service: still-queued jobs complete as CANCELLED
+        immediately; workers are joined against ONE shared deadline (a
+        wedged job cannot hang shutdown); any job still RUNNING when the
+        deadline expires is finished FAILED with a "shutdown" reason
+        (its worker's own later finalize is a no-op: finish() is
+        idempotent and returns False to the loser)."""
         self._shutdown = True
         with self._queue_cv:
+            drained = list(self._queue)
+            self._queue.clear()
             self._queue_cv.notify_all()
-        if wait:
-            for thread in self._workers:
-                thread.join(timeout)
+        for job in drained:
+            if job.finish(JobState.CANCELLED):
+                self.jobs_cancelled += 1
+        if not wait:
+            return
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        for thread in self._workers:
+            if deadline is None:
+                thread.join()
+            else:
+                thread.join(max(0.0, deadline - time.monotonic()))
+        for job in list(self._jobs.values()):
+            if not job.done_event.is_set():
+                # ask the engine to stop at its next cancellation check
+                # (in-flight states put back per the timeout-path
+                # semantics), but do not wait for it: the job fails NOW
+                job.cancel_event.set()
+                job.error = "service shutdown before job completed"
+                if job.finish(JobState.FAILED):
+                    self.jobs_failed += 1
 
     # -------------------------------------------------------------- workers
 
@@ -318,8 +389,8 @@ class AnalysisService:
                 while self._queue:
                     job = self._queue.popleft()
                     if job.cancel_event.is_set():
-                        job.finish(JobState.CANCELLED)
-                        self.jobs_cancelled += 1
+                        if job.finish(JobState.CANCELLED):
+                            self.jobs_cancelled += 1
                         continue
                     return job
                 if self._shutdown:
@@ -333,24 +404,64 @@ class AnalysisService:
                 return
             try:
                 self._run_job(job)
-            except BaseException:  # pragma: no cover - worker survives
-                log.exception("worker crashed on job %d", job.id)
-                if not job.done_event.is_set():
-                    job.error = "internal worker failure"
-                    job.finish(JobState.FAILED)
+            except BaseException as e:  # pragma: no cover - worker survives
+                # last-ditch isolation: _run_job classifies crashes
+                # itself, so reaching here means the SCHEDULER plumbing
+                # failed — the job dies, the worker survives
+                log.exception("worker crashed on job %d: %s", job.id, e)
+                job.error = "internal worker failure: %s" % e
+                if job.finish(JobState.FAILED):
                     self.jobs_failed += 1
 
     def _run_job(self, job: AnalysisJob) -> None:
+        """One job, at most two attempts.
+
+        A crashed first attempt records a strike against the code hash
+        and retries ONCE — from the job's latest frontier checkpoint
+        when one was journaled, from scratch otherwise. A second crash
+        records the second strike (= quarantine: later submissions of
+        this hash are rejected at admission) and the job fails with its
+        structured error report. Transient faults the retry absorbed
+        leave no strikes behind (_finalize -> cache.record_success)."""
+        job.state = JobState.RUNNING
+        job.started_at = time.time()
+        outcome = self._run_attempt(job, attempt=0)
+        if (
+            outcome["crashed"]
+            and not job.cancel_event.is_set()
+            and not self._shutdown
+        ):
+            strikes = self.cache.record_crash(job.key, outcome["report"])
+            if strikes < QUARANTINE_AFTER:
+                ckpt = self.journal.latest(job.id)
+                log.warning(
+                    "retrying job %d once from %s",
+                    job.id,
+                    ckpt if ckpt is not None else "scratch",
+                )
+                job.retried = True
+                self.jobs_retried += 1
+                outcome = self._run_attempt(job, attempt=1, resume=ckpt)
+                if outcome["crashed"] and not job.cancel_event.is_set():
+                    self.cache.record_crash(job.key, outcome["report"])
+        self.journal.clear(job.id)
+        self._finalize(job, outcome)
+
+    def _run_attempt(
+        self, job: AnalysisJob, attempt: int, resume=None
+    ) -> Dict:
+        """One analysis attempt; never raises. Returns
+        ``{"issues", "error", "report", "crashed"}`` and accumulates the
+        attempt's ladder counters onto the job."""
         from mythril_tpu.analysis.security import fire_lasers_for_job
         from mythril_tpu.analysis.symbolic import SymExecWrapper
         from mythril_tpu.ethereum.evmcontract import EVMContract
 
-        job.state = JobState.RUNNING
-        job.started_at = time.time()
         ctx = JobContext(job.id, self.coordinator, job.cancel_event)
         self.coordinator.job_started()
-        issues = []
-        error: Optional[str] = None
+        outcome: Dict = {
+            "issues": [], "error": None, "report": None, "crashed": False,
+        }
         # solver-seam warmth + fallback hygiene (laser/tpu/solver_cache):
         # seed the verdict memo accumulated by earlier runs of this code
         # hash, and tag this thread's async host-solver submissions with
@@ -358,14 +469,27 @@ class AnalysisService:
         # job's pending queries are DROPPED by the pool, never solved.
         from mythril_tpu.laser.tpu import solver_cache
 
-        solver_cache.GLOBAL.seed_memo(self.cache.get_solver_memo(job.key))
-        solver_cache.set_job_context(
-            deadline=(
-                job.started_at + float(job.timeout) if job.timeout else None
-            ),
-            cancel_event=job.cancel_event,
-        )
+        laser_box: List = []
+        rounds_offset = resume.rounds_done if resume is not None else 0
+
+        def pre_exec(laser):
+            ctx.install(laser)
+            laser_box.append(laser)
+            self.journal.install(
+                job.id, laser, total_rounds=job.tx_count,
+                rounds_offset=rounds_offset,
+            )
+
         try:
+            solver_cache.GLOBAL.seed_memo(self.cache.get_solver_memo(job.key))
+            solver_cache.set_job_context(
+                deadline=(
+                    job.started_at + float(job.timeout)
+                    if job.timeout else None
+                ),
+                cancel_event=job.cancel_event,
+            )
+            faults.fire(faults.SCHEDULER_WORKER, context=job.name)
             contract = EVMContract(
                 code=job.runtime_hex,
                 creation_code=job.creation_hex,
@@ -382,29 +506,68 @@ class AnalysisService:
                     transaction_count=job.tx_count,
                     max_depth=job.max_depth,
                     modules=job.modules,
-                    pre_exec_hook=ctx.install,
+                    pre_exec_hook=pre_exec,
                     fresh_solver_core=False,
+                    resume_from=resume,
                 )
-                issues = fire_lasers_for_job(
+                outcome["issues"] = fire_lasers_for_job(
                     sym, {job.internal_name}, job.modules
                 )
         except Exception as e:
-            log.warning("job %d failed: %s", job.id, e)
-            error = str(e)
+            rounds = 0
+            if laser_box:
+                rounds = getattr(
+                    laser_box[0], "executed_transaction_rounds", 0
+                )
+            outcome["error"] = str(e)
+            outcome["crashed"] = True
+            outcome["report"] = {
+                "exception": type(e).__name__,
+                "seam": getattr(e, "seam", None),
+                "kind": getattr(e, "kind", None),
+                "round": rounds,
+                "attempt": attempt,
+                "message": str(e),
+            }
+            log.warning(
+                "job %d attempt %d crashed (%s at seam %s, round %d)",
+                job.id, attempt, type(e).__name__,
+                getattr(e, "seam", None) or "-", rounds,
+            )
         finally:
+            # ALWAYS clear this worker thread's job context: a crashed
+            # job's deadline/cancel context must never leak onto the
+            # next job this worker picks up (satellite regression)
             solver_cache.clear_job_context()
             self.coordinator.job_finished()
+            if laser_box:
+                from mythril_tpu.laser.tpu import backend
 
+                strat = backend.find_tpu_strategy(laser_box[0].strategy)
+                if strat is not None:
+                    job.device_retries += strat.device_retries
+                    job.degraded_rounds += strat.degraded_rounds
+        return outcome
+
+    def _finalize(self, job: AnalysisJob, outcome: Dict) -> None:
+        from mythril_tpu.laser.tpu import solver_cache
+
+        job.degraded = bool(
+            job.retried or job.device_retries or job.degraded_rounds
+        )
         if job.cancel_event.is_set():
-            job.finish(JobState.CANCELLED)
-            self.jobs_cancelled += 1
+            if job.finish(JobState.CANCELLED):
+                self.jobs_cancelled += 1
             return
-        if error is not None:
-            job.error = error
-            job.finish(JobState.FAILED)
-            self.jobs_failed += 1
+        if outcome["error"] is not None:
+            job.error = outcome["error"]
+            job.error_report = outcome["report"]
+            if job.finish(JobState.FAILED):
+                self.jobs_failed += 1
             return
 
+        self.cache.record_success(job.key)
+        issues = outcome["issues"]
         # the user asked about <name>, not the internal tenancy name
         for issue in issues:
             issue.contract = job.name
@@ -414,8 +577,15 @@ class AnalysisService:
             "issues": issue_dicts,
             "swc_ids": swc_ids,
             "cache_hit": False,
+            "degraded": job.degraded,
+            "retried": job.retried,
+            "device_retries": job.device_retries,
+            "degraded_rounds": job.degraded_rounds,
         }
-        job.finish(JobState.DONE)
+        if not job.finish(JobState.DONE):
+            # shutdown failed this job while its worker was finalizing;
+            # the shutdown verdict stands and nothing is cached
+            return
         self.jobs_done += 1
         # export the verdicts this job decided so resubmissions of the
         # same contract (any parameters) start with a warm memo table

@@ -48,4 +48,14 @@ python -m pytest tests/service/test_cache.py \
     tests/service/test_api.py \
     -q -p no:cacheprovider
 
+echo "== robustness fast tests =="
+# fault harness parsing/determinism, retry ladder + breaker transitions,
+# checkpoint journal, and the scheduler crash-isolation/quarantine unit
+# tests — all host-side stubs, no symbolic execution. The full
+# fault-matrix property test (every seam x every fault kind through the
+# real pipeline) runs with the full suite; -k trims to the fast half.
+python -m pytest tests/robustness/ \
+    -q -p no:cacheprovider \
+    -k "not matrix and not slow"
+
 echo "ALL CHECKS PASSED"

@@ -23,6 +23,10 @@ standard library can check reliably:
     into another job's lanes breaks the multi-tenant isolation
     invariants in docs/SERVICE.md; the tpu kernel modules that OWN the
     planes and tests are exempt, as are noqa'd lines)
+  - no anonymous catch-alls at the fault seams (in the files hosting
+    fault-injection seams — see docs/ROBUSTNESS.md — a catch-all
+    handler must reference the bound exception or re-raise, so failures
+    are classified rather than silenced; noqa exempts)
   - no tabs in indentation, no trailing whitespace, newline at EOF
 
 Run via scripts/check.sh. Exit 0 = clean.
@@ -423,6 +427,71 @@ def solver_boundary(tree: ast.AST, source: str, rel: str):
     return sorted(set(out))
 
 
+# Files that host a fault-injection seam (docs/ROBUSTNESS.md). Inside
+# these, a catch-all handler must CLASSIFY the failure — reference the
+# bound exception (log it, inspect .seam/.kind, wrap it in a report) or
+# re-raise — never absorb it anonymously: a silently-eaten InjectedFault
+# here turns a fault-matrix test into a false pass and, in production,
+# turns a device failure into a wrong-answer path instead of a
+# degraded/UNKNOWN one.
+_SEAM_FILES = {
+    "mythril_tpu/laser/tpu/backend.py",
+    "mythril_tpu/laser/tpu/transfer.py",
+    "mythril_tpu/laser/tpu/bridge.py",
+    "mythril_tpu/laser/tpu/solver_jax.py",
+    "mythril_tpu/laser/tpu/solver_cache.py",
+    "mythril_tpu/service/scheduler.py",
+    "mythril_tpu/service/lanes.py",
+    "mythril_tpu/robustness/faults.py",
+    "mythril_tpu/robustness/retry.py",
+    "mythril_tpu/robustness/checkpoint.py",
+}
+
+
+def seam_exceptions(tree: ast.AST, source: str, rel: str):
+    """(lineno, desc) pairs for catch-all except handlers in seam files
+    whose body neither references the bound exception nor raises. The
+    global swallowed_exceptions rule only flags pass-only bodies; at the
+    fault seams the bar is higher — ``except Exception: continue`` or a
+    handler that logs a static string still erases WHICH failure fired,
+    and the retry ladder / crash reports / fault-matrix tests all depend
+    on the exception object reaching a classifier. noqa exempts."""
+    if rel not in _SEAM_FILES:
+        return []
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        catch_all = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")
+        )
+        if not catch_all or _noqa(lines, node.lineno):
+            continue
+        classified = False
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    classified = True
+                elif (
+                    node.name
+                    and isinstance(sub, ast.Name)
+                    and sub.id == node.name
+                ):
+                    classified = True
+            if classified:
+                break
+        if not classified:
+            out.append((
+                node.lineno,
+                "catch-all handler at a fault seam neither references "
+                "the exception nor raises (classify failures, don't "
+                "silence them)",
+            ))
+    return sorted(set(out))
+
+
 def main() -> int:
     problems = []
     n_files = 0
@@ -448,6 +517,8 @@ def main() -> int:
         for lineno, desc in lane_indexing(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for lineno, desc in solver_boundary(tree, source, str(rel)):
+            problems.append(f"{rel}:{lineno}: {desc}")
+        for lineno, desc in seam_exceptions(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")

@@ -3,14 +3,16 @@
 Regression pin for the frontier feasibility triage
 (laser/tpu/backend.py filter_feasible): when the batched device solver
 cannot decide an instance — CNF blasting exceeds the kernel caps
-(solver_jax.CapExceeded -> verdict None), the search budget runs out, or
-the dispatch itself fails — the lane must survive the round (unknown
-counts as possible; settlement re-solves authoritatively, and in
-service mode the async pool folds a late verdict into the memo), never
-be treated as infeasible. Dropping undecided-but-satisfiable states
-would silently truncate exploration (missed detections), which is
-exactly the failure mode these tests make loud. When the device is NOT
-available (pre-warmup / sub-floor frontier), the inline quick host
+(solver_jax.CapExceeded -> verdict None) or the search budget runs out
+— the lane must survive the round (unknown counts as possible;
+settlement re-solves authoritatively, and in service mode the async
+pool folds a late verdict into the memo), never be treated as
+infeasible. Dropping undecided-but-satisfiable states would silently
+truncate exploration (missed detections), which is exactly the failure
+mode these tests make loud. When the device dispatch itself FAILS, the
+batch degrades to the inline host path, which decides authoritatively
+without memoizing anything for the faulted dispatch. When the device is
+NOT available (pre-warmup / sub-floor frontier), the inline quick host
 check is the only pruner and must still decide the frontier.
 """
 
@@ -75,7 +77,12 @@ def test_undecided_verdicts_survive_optimistically(monkeypatch, device_engaged):
     assert unsat.world_state.constraints._is_possible is True
 
 
-def test_dispatch_failure_survives_optimistically(monkeypatch, device_engaged):
+def test_dispatch_failure_degrades_to_inline_host(monkeypatch, device_engaged):
+    # a failed device dispatch is not an undecided verdict: the batch
+    # falls back to the inline host solver, which decides the frontier
+    # authoritatively and records nothing as device-decided
+    from mythril_tpu.laser.tpu import solver_cache
+
     sat, unsat = _frontier()
 
     def boom(sets, **kw):
@@ -83,7 +90,9 @@ def test_dispatch_failure_survives_optimistically(monkeypatch, device_engaged):
 
     monkeypatch.setattr(solver_jax, "feasibility_batch", boom)
     survivors = backend.filter_feasible([sat, unsat])
-    assert survivors == [sat, unsat]
+    assert survivors == [sat]
+    assert unsat.world_state.constraints._is_possible is False
+    assert solver_cache.GLOBAL.stats()["device_decided"] == 0
 
 
 def test_host_decides_when_device_unavailable(monkeypatch):

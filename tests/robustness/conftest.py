@@ -1,0 +1,15 @@
+import pytest
+
+from mythril_tpu.robustness import faults, retry
+
+
+@pytest.fixture(autouse=True)
+def _disarmed_faults():
+    """Every test starts and ends with no fault plan armed and a closed
+    circuit breaker — an armed plan or tripped breaker leaking across
+    tests would fail unrelated assertions far from the cause."""
+    faults.configure(None)
+    retry.BREAKER.reset()
+    yield
+    faults.configure(None)
+    retry.BREAKER.reset()

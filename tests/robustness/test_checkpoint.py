@@ -1,0 +1,111 @@
+"""CheckpointJournal: cadence, latest-only retention, absolute round
+numbering across a resume, snapshot isolation, and best-effort failure
+behaviour. The laser is faked — the hook contract is just
+register_laser_hooks('stop_sym_trans') + executed_transaction_address +
+open_states; the real resume path runs in the service fault matrix."""
+
+from mythril_tpu.robustness.checkpoint import CheckpointJournal, FrontierCheckpoint
+
+
+class FakeLaser:
+    def __init__(self, address=0x1234):
+        self.executed_transaction_address = address
+        self.open_states = []
+        self.hooks = []
+
+    def register_laser_hooks(self, kind, hook):
+        assert kind == "stop_sym_trans"
+        self.hooks.append(hook)
+
+    def end_round(self):
+        for hook in self.hooks:
+            hook()
+
+
+def test_journal_keeps_only_latest_and_skips_final_round():
+    journal = CheckpointJournal(every=1)
+    laser = FakeLaser()
+    journal.install("7", laser, total_rounds=3)
+    laser.open_states = ["r1-frontier"]
+    laser.end_round()
+    ckpt1 = journal.latest("7")
+    assert ckpt1 is not None and ckpt1.rounds_done == 1
+    laser.open_states = ["r2-a", "r2-b"]
+    laser.end_round()
+    ckpt2 = journal.latest("7")
+    assert ckpt2.rounds_done == 2 and ckpt2.n_states == 2
+    # final round: the job is done, nothing left worth resuming
+    laser.end_round()
+    assert journal.latest("7").rounds_done == 2
+    assert journal.stats()["snapshots"] == 2
+    assert journal.stats()["overhead_s"] >= 0.0
+    journal.clear("7")
+    assert journal.latest("7") is None
+
+
+def test_cadence_every_k_rounds():
+    journal = CheckpointJournal(every=2)
+    laser = FakeLaser()
+    journal.install("j", laser, total_rounds=6)
+    taken = []
+    for r in range(1, 6):
+        laser.open_states = ["round-%d" % r]
+        laser.end_round()
+        ckpt = journal.latest("j")
+        taken.append(ckpt.rounds_done if ckpt else None)
+    assert taken == [None, 2, 2, 4, 4]
+
+
+def test_zero_disables_journaling():
+    journal = CheckpointJournal(every=0)
+    laser = FakeLaser()
+    journal.install("j", laser, total_rounds=5)
+    assert laser.hooks == []  # no hook even registered
+
+
+def test_rounds_offset_keeps_numbering_absolute():
+    """A resumed attempt keeps counting from its checkpoint: round
+    numbers in crash reports and later checkpoints stay absolute."""
+    journal = CheckpointJournal(every=1)
+    laser = FakeLaser()
+    journal.install("j", laser, total_rounds=5, rounds_offset=2)
+    laser.open_states = ["after-round-3"]
+    laser.end_round()
+    assert journal.latest("j").rounds_done == 3
+
+
+def test_snapshot_is_isolated_from_live_mutation():
+    journal = CheckpointJournal(every=1)
+    laser = FakeLaser()
+    journal.install("j", laser, total_rounds=2)
+    frontier = [{"balance": 1}]
+    laser.open_states = frontier
+    laser.end_round()
+    frontier[0]["balance"] = 999       # later rounds mutate the live set
+    restored = journal.latest("j").restore()
+    assert restored == [{"balance": 1}]
+
+
+def test_unpicklable_frontier_costs_the_checkpoint_not_the_round():
+    journal = CheckpointJournal(every=1)
+    laser = FakeLaser()
+    journal.install("j", laser, total_rounds=3)
+    laser.open_states = [lambda: None]  # pickle refuses local lambdas
+    laser.end_round()                   # must not raise
+    assert journal.latest("j") is None
+    laser.open_states = ["fine"]
+    laser.end_round()                   # later rounds journal again
+    assert journal.latest("j").rounds_done == 2
+
+
+def test_env_tunes_cadence(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_CKPT_EVERY", "3")
+    assert CheckpointJournal().every == 3
+    monkeypatch.setenv("MYTHRIL_TPU_CKPT_EVERY", "junk")
+    assert CheckpointJournal().every == 1  # warns, falls back to default
+
+
+def test_restore_returns_fresh_objects_each_time():
+    ckpt = FrontierCheckpoint("j", 1, 0x1234, [{"slot": 1}])
+    a, b = ckpt.restore(), ckpt.restore()
+    assert a == b and a is not b and a[0] is not b[0]

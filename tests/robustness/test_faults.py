@@ -1,0 +1,147 @@
+"""The fault-injection harness itself: spec parsing, deterministic
+firing, per-rule options, and the classification grid (every seam x
+every kind raises the documented exception carrying .seam/.kind) — the
+fast half of the fault matrix that scripts/check.sh runs."""
+
+import pytest
+
+from mythril_tpu.robustness import faults
+
+
+# -- spec parsing -----------------------------------------------------------
+
+
+def test_parse_rejects_malformed_specs():
+    for bad in (
+        "nonsense",                      # no '='
+        "not_a_seam=oom",                # unknown seam
+        "device_round=not_a_kind",       # unknown kind
+        "device_round=oom:p=zero",       # bad option value
+        "device_round=oom:frob=1",       # unknown option
+        "seed=xyz;device_round=oom",     # bad seed
+    ):
+        with pytest.raises(faults.FaultSpecError):
+            faults.FaultPlan.parse(bad)
+
+
+def test_parse_full_spec_shape():
+    plan = faults.FaultPlan.parse(
+        "seed=7;device_round=oom:n=1;host_solve=timeout:p=0.5,after=2;"
+        "scheduler_worker=crash:match=poison"
+    )
+    assert plan.seed == 7
+    rule = plan.rules[faults.DEVICE_ROUND][0]
+    assert (rule.kind, rule.n) == ("oom", 1)
+    rule = plan.rules[faults.HOST_SOLVE][0]
+    assert (rule.p, rule.after) == (0.5, 2)
+    rule = plan.rules[faults.SCHEDULER_WORKER][0]
+    assert rule.match == "poison"
+
+
+def test_disarmed_fire_is_a_noop():
+    faults.configure(None)
+    for seam in faults.SEAMS:
+        faults.fire(seam)  # must not raise
+    assert faults.active() is None
+
+
+def test_env_gating(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "device_round=error:n=1")
+    faults.reset()  # next crossing re-reads the environment
+    with pytest.raises(faults.DeviceRuntimeFault):
+        faults.fire(faults.DEVICE_ROUND)
+    monkeypatch.delenv(faults.ENV_VAR)
+    faults.reset()
+    faults.fire(faults.DEVICE_ROUND)  # disarmed again
+
+
+# -- per-rule options -------------------------------------------------------
+
+
+def test_n_limits_fires():
+    plan = faults.configure("transfer_up=error:n=2")
+    fired = 0
+    for _ in range(10):
+        try:
+            faults.fire(faults.TRANSFER_UP)
+        except faults.DeviceRuntimeFault:
+            fired += 1
+    assert fired == 2
+    assert plan.counts() == {faults.TRANSFER_UP: 2}
+    assert plan.total_fired() == 2
+
+
+def test_after_skips_leading_hits():
+    faults.configure("host_solve=timeout:after=3,n=1")
+    for _ in range(3):
+        faults.fire(faults.HOST_SOLVE)  # hits 1-3 pass clean
+    with pytest.raises(faults.InjectedTimeout):
+        faults.fire(faults.HOST_SOLVE)  # hit 4 fires
+
+
+def test_match_filters_on_context():
+    faults.configure("scheduler_worker=crash:match=poison")
+    faults.fire(faults.SCHEDULER_WORKER, context="benign-job")
+    faults.fire(faults.SCHEDULER_WORKER)  # no context at all
+    with pytest.raises(faults.InjectedCrash):
+        faults.fire(faults.SCHEDULER_WORKER, context="poison-pill")
+
+
+def test_probabilistic_firing_is_seed_deterministic():
+    def trace(spec, crossings=200):
+        faults.configure(spec)
+        out = []
+        for i in range(crossings):
+            try:
+                faults.fire(faults.SOLVER_BATCH)
+                out.append(False)
+            except faults.InjectedFault:
+                out.append(True)
+        return out
+
+    a = trace("seed=11;solver_batch=garbage:p=0.3")
+    b = trace("seed=11;solver_batch=garbage:p=0.3")
+    c = trace("seed=12;solver_batch=garbage:p=0.3")
+    assert a == b                      # same seed -> identical schedule
+    assert a != c                      # different seed -> different one
+    assert 20 < sum(a) < 120           # p=0.3 actually thins the firing
+
+
+# -- the classification grid (fast fault matrix) ----------------------------
+
+_EXPECTED = {
+    "oom": faults.DeviceOOM,
+    "error": faults.DeviceRuntimeFault,
+    "timeout": faults.InjectedTimeout,
+    "worker_death": faults.WorkerDeath,
+    "garbage": faults.GarbageModel,
+    "crash": faults.InjectedCrash,
+}
+
+
+@pytest.mark.parametrize("seam", faults.SEAMS)
+@pytest.mark.parametrize("kind", faults.KINDS)
+def test_every_seam_kind_pair_classifies(seam, kind):
+    """Each (seam, kind) cell raises the documented exception class and
+    the instance self-identifies — error reports and the retry ladder
+    both classify on .seam/.kind, so these must never be lost."""
+    faults.configure("%s=%s:n=1" % (seam, kind))
+    with pytest.raises(_EXPECTED[kind]) as exc_info:
+        faults.fire(seam)
+    exc = exc_info.value
+    assert isinstance(exc, faults.InjectedFault)
+    assert exc.seam == seam
+    assert exc.kind == kind
+    faults.fire(seam)  # n=1 exhausted: the seam is clean again
+
+
+def test_oom_matches_the_xla_resource_exhausted_shape():
+    """The retry ladder recognizes OOM by message shape for real XLA
+    errors; the injected one must match the same detector."""
+    from mythril_tpu.robustness.retry import _is_oom
+
+    faults.configure("device_round=oom:n=1")
+    with pytest.raises(faults.DeviceOOM) as exc_info:
+        faults.fire(faults.DEVICE_ROUND)
+    assert _is_oom(exc_info.value)
+    assert "RESOURCE_EXHAUSTED" in str(exc_info.value)

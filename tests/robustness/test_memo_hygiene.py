@@ -1,0 +1,179 @@
+"""Memo hygiene under injected solver faults (acceptance gate: no
+memoized verdict may differ from a fresh host solve, and a fault must
+never masquerade as an exhausted budget). Covers the solver_batch /
+host_solve / fallback_worker seams at the decide_batch and FallbackPool
+layers — real host CDCL on tiny formulas, no device kernel."""
+
+import random
+import time
+
+import pytest
+
+from mythril_tpu.laser.tpu import solver_cache as sc
+from mythril_tpu.laser.tpu import solver_jax as sj
+from mythril_tpu.robustness import faults
+from mythril_tpu.smt import ULT, UGT, symbol_factory
+from mythril_tpu.smt.solver.incremental import IncrementalCore
+
+W = 16
+
+
+def bv(name):
+    return symbol_factory.BitVecSym(name, W)
+
+
+def val(v):
+    return symbol_factory.BitVecVal(v, W)
+
+
+def formulas(prefix, seed, count=8):
+    rng = random.Random(seed)
+    out = []
+    for i in range(count):
+        a = bv("%s_a%d" % (prefix, i))
+        b = bv("%s_b%d" % (prefix, i))
+        k1, k2, k3 = (val(v) for v in rng.sample(range(1, 1 << W), 3))
+        atoms = [a + k1 == b, ULT(a, k2), UGT(b, k3)]
+        out.append([t.raw for t in atoms[: rng.randrange(2, 4)]])
+    return out
+
+
+def fresh_host_verdict(raw_terms):
+    return sc._host_check(raw_terms, 10_000, core=IncrementalCore())
+
+
+def assert_memo_matches_fresh(cache, corpus):
+    """Every memoized verdict for ``corpus`` is bit-for-bit the fresh
+    host answer; UNKNOWN memos are allowed only where fresh also fails
+    to decide (never as a fault residue — these formulas all decide)."""
+    for fs in corpus:
+        code, _ = cache.lookup(fs)
+        if code is None:
+            continue
+        assert code == fresh_host_verdict(fs), fs
+
+
+# -- solver_batch seam: faulted device dispatch ----------------------------
+
+
+def test_faulted_device_dispatch_degrades_inline_and_memo_stays_clean(
+    monkeypatch,
+):
+    """When the batched device SAT dispatch dies, decide_batch must fall
+    back to the inline host path (the residue was never solved) and the
+    memo must end up exactly as a device-less run would leave it —
+    no UNKNOWN entries invented for the faulted dispatch."""
+    def faulting_batch(sets, flips=384, models=None, return_models=False):
+        faults.fire(faults.SOLVER_BATCH, context="check_batch")
+        raise AssertionError("unreachable: the seam always fires")
+
+    monkeypatch.setattr(sj, "feasibility_batch", faulting_batch)
+    faults.configure("solver_batch=garbage")
+    cache = sc.SolverCache()
+    corpus = formulas("devf", 31)
+    verdicts = cache.decide_batch(corpus, use_device=True)
+    for fs, verdict in zip(corpus, verdicts):
+        truth = fresh_host_verdict(fs)
+        if verdict is True:
+            assert truth == sc.SAT
+        elif verdict is False:
+            assert truth == sc.UNSAT
+    assert cache.stats()["device_decided"] == 0
+    assert_memo_matches_fresh(cache, corpus)
+
+
+# -- host_solve seam: faulted inline host check ----------------------------
+
+
+def test_faulted_host_check_records_nothing():
+    """A faulted host check is NOT an exhausted budget: the verdict
+    stays optimistic (None) and the memo learns nothing, so a later
+    clean query re-solves and records the true verdict."""
+    faults.configure("host_solve=timeout")
+    cache = sc.SolverCache()
+    fs = [(bv("hsf_a") == val(3)).raw]
+    assert cache.decide_batch([fs], use_device=False) == [None]
+    code, _ = cache.lookup(fs)
+    assert code is None                 # nothing memoized for the fault
+    assert cache.stats()["unknown"] == 0
+
+    faults.configure(None)
+    verdict = cache.decide_batch([fs], use_device=False)
+    assert verdict == [fresh_host_verdict(fs) == sc.SAT]
+    code, _ = cache.lookup(fs)
+    assert code == fresh_host_verdict(fs)
+
+
+def test_intermittent_host_faults_never_poison_the_memo():
+    """Probabilistic host faults across a corpus: everything that DID
+    get memoized matches fresh truth (the acceptance property at the
+    solver layer)."""
+    faults.configure("seed=5;host_solve=timeout:p=0.5")
+    cache = sc.SolverCache()
+    corpus = formulas("ihf", 77)
+    cache.decide_batch(corpus, use_device=False)
+    faults.configure(None)
+    assert_memo_matches_fresh(cache, corpus)
+
+
+# -- fallback_worker seam: pool hygiene ------------------------------------
+
+
+def _pooled_cache(autostart=False, workers=1):
+    cache = sc.SolverCache()
+    cache.pool = sc.FallbackPool(cache, autostart=autostart, workers=workers)
+    return cache
+
+
+def test_worker_death_releases_inflight_key_and_records_nothing():
+    cache = _pooled_cache()
+    fs = [(bv("wd_a") == val(7)).raw]
+    key = cache._key_of(fs)
+    assert cache.pool.submit(key, fs)
+    faults.configure("fallback_worker=worker_death:n=1")
+    with pytest.raises(faults.WorkerDeath):
+        cache.pool.process_once()
+    # the dropped query's key is free again and nothing was memoized
+    assert cache.pool.pending() == 0
+    assert not cache.pool._inflight_keys
+    code, _ = cache.lookup(fs)
+    assert code is None
+    # the instance can be resubmitted and now resolves to fresh truth
+    assert cache.pool.submit(key, fs)
+    assert cache.pool.process_once()
+    code, _ = cache.lookup(fs)
+    assert code == fresh_host_verdict(fs)
+
+
+def test_faulted_pool_solve_settles_unknown_without_memo():
+    cache = _pooled_cache()
+    fs = [(bv("fp_a") == val(9)).raw]
+    assert cache.pool.submit(cache._key_of(fs), fs)
+    faults.configure("host_solve=timeout:n=1")
+    assert cache.pool.process_once()    # absorbed: UNKNOWN, no record
+    assert cache.stats()["async_completed"] == 1
+    code, _ = cache.lookup(fs)
+    assert code is None
+
+
+def test_dead_pool_worker_respawns_on_next_submit():
+    """A real dead worker thread is pruned and replaced by the next
+    submission's _ensure_threads, and the replacement still solves."""
+    cache = _pooled_cache(autostart=True, workers=1)
+    faults.configure("fallback_worker=worker_death:n=1")
+    doomed = [(bv("rs_a") == val(1)).raw]
+    assert cache.pool.submit(cache._key_of(doomed), doomed)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        threads = [t for t in cache.pool._threads if t.is_alive()]
+        if cache.pool._spawned >= 1 and not threads:
+            break
+        time.sleep(0.01)
+    assert not [t for t in cache.pool._threads if t.is_alive()]
+
+    survivor = [(bv("rs_b") == val(2)).raw]
+    assert cache.pool.submit(cache._key_of(survivor), survivor)
+    assert cache.pool._spawned == 2     # pruned the corpse, respawned
+    cache.pool.drain(timeout=10)
+    code, _ = cache.lookup(survivor)
+    assert code == fresh_host_verdict(survivor)

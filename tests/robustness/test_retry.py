@@ -1,0 +1,187 @@
+"""The retry/degrade ladder: run_round_guarded's backoff/OOM semantics
+and the circuit breaker's closed -> open -> half-open -> closed cycle.
+The device round is stubbed (backend._run_device / transfer.batch_to_host
+monkeypatched); the real-round path runs in the service fault matrix."""
+
+import pytest
+
+from mythril_tpu.laser.tpu import backend, transfer
+from mythril_tpu.robustness import faults, retry
+
+
+class StubBridge:
+    """bridge.finish() stand-in; re-runnable like the real one."""
+
+    def __init__(self):
+        self.finishes = 0
+
+    def finish(self):
+        self.finishes += 1
+        return "cb", "st"
+
+
+@pytest.fixture
+def stub_round(monkeypatch):
+    """Patch the device round to a controllable script of outcomes."""
+    script = []
+
+    def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
+        outcome = script.pop(0)
+        if isinstance(outcome, Exception):
+            raise outcome
+        return outcome, ["hist"]
+
+    monkeypatch.setattr(backend, "_run_device", _run_device)
+    monkeypatch.setattr(transfer, "batch_to_host", lambda out: ("host", out))
+    return script
+
+
+def no_sleep(_):
+    pass
+
+
+def test_clean_round_passes_through(stub_round):
+    stub_round.append("dev-out")
+    bridge = StubBridge()
+    counters = retry.RoundCounters()
+    out, hist, wall = retry.run_round_guarded(
+        bridge, cfg=None, counters=counters, sleep=no_sleep
+    )
+    assert out == ("host", "dev-out")
+    assert hist == ["hist"]
+    assert wall >= 0.0
+    assert counters.device_retries == 0
+    assert bridge.finishes == 1
+    assert retry.BREAKER.state() == "closed"
+
+
+def test_transient_failure_retries_and_reuploads(stub_round):
+    stub_round.extend([RuntimeError("XLA runtime error: flaky"), "dev-out"])
+    bridge = StubBridge()
+    counters = retry.RoundCounters()
+    slept = []
+    out, _, _ = retry.run_round_guarded(
+        bridge, cfg=None, counters=counters, sleep=slept.append
+    )
+    assert out == ("host", "dev-out")
+    assert counters.device_retries == 1
+    assert bridge.finishes == 2          # the retry re-ran the upload
+    assert slept and slept[0] == retry.BACKOFF_BASE_S
+    assert retry.BREAKER.state() == "closed"
+
+
+def test_backoff_grows_and_exhaustion_raises(stub_round):
+    stub_round.extend(
+        RuntimeError("XLA runtime error: down") for _ in range(3)
+    )
+    slept = []
+    with pytest.raises(retry.DeviceRoundError) as exc_info:
+        retry.run_round_guarded(
+            StubBridge(), cfg=None,
+            counters=retry.RoundCounters(), sleep=slept.append,
+        )
+    assert len(slept) == retry.DEVICE_MAX_RETRIES
+    assert slept == sorted(slept)        # exponential: non-decreasing
+    assert not exc_info.value.oom
+    assert isinstance(exc_info.value.cause, RuntimeError)
+
+
+def test_oom_skips_retries_and_flags(stub_round):
+    stub_round.append(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    bridge = StubBridge()
+    with pytest.raises(retry.DeviceRoundError) as exc_info:
+        retry.run_round_guarded(
+            bridge, cfg=None, counters=retry.RoundCounters(), sleep=no_sleep
+        )
+    assert exc_info.value.oom            # caller halves its pack cap
+    assert bridge.finishes == 1          # no pointless same-size retry
+
+
+def test_injected_seam_fault_carries_seam_name(stub_round):
+    faults.configure("device_round=error:n=3")  # > attempts: all fail
+    with pytest.raises(retry.DeviceRoundError) as exc_info:
+        retry.run_round_guarded(
+            StubBridge(), cfg=None,
+            counters=retry.RoundCounters(), sleep=no_sleep,
+        )
+    assert exc_info.value.seam == faults.DEVICE_ROUND
+
+
+def test_transfer_down_fault_is_absorbed_by_one_retry(stub_round):
+    stub_round.extend(["dev-out", "dev-out"])
+    faults.configure("transfer_down=error:n=1")
+
+    calls = []
+
+    def flaky(out):
+        calls.append(out)
+        faults.fire(faults.TRANSFER_DOWN, context="batch_to_host")
+        return ("host", out)
+
+    import unittest.mock as mock
+    with mock.patch.object(transfer, "batch_to_host", flaky):
+        counters = retry.RoundCounters()
+        out, _, _ = retry.run_round_guarded(
+            StubBridge(), cfg=None, counters=counters, sleep=no_sleep
+        )
+    assert out == ("host", "dev-out")
+    assert counters.device_retries == 1
+    assert len(calls) == 2
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+def test_breaker_opens_after_threshold_and_half_opens():
+    breaker = retry.CircuitBreaker(threshold=3, cooldown_s=0.05)
+    for _ in range(2):
+        breaker.record_failure()
+    assert breaker.state() == "closed" and breaker.allow()
+    breaker.record_failure()
+    assert breaker.state() == "open"
+    assert not breaker.allow()
+    assert breaker.trips == 1
+    # cooldown elapses -> half-open admits a trial
+    import time as _time
+
+    _time.sleep(0.06)
+    assert breaker.state() == "half-open"
+    assert breaker.allow()
+    # failed trial restarts the cooldown without another trip
+    breaker.record_failure()
+    assert breaker.state() == "open" and breaker.trips == 1
+    _time.sleep(0.06)
+    breaker.record_success()
+    assert breaker.state() == "closed" and breaker.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    breaker = retry.CircuitBreaker(threshold=2, cooldown_s=60)
+    breaker.record_failure()
+    breaker.record_success()
+    breaker.record_failure()
+    assert breaker.state() == "closed"  # never 2 CONSECUTIVE failures
+
+
+def test_allow_claims_nothing():
+    """A caller that checks allow() and then never runs a round must not
+    wedge the breaker (the half-open trial is not a lease)."""
+    breaker = retry.CircuitBreaker(threshold=1, cooldown_s=0.0)
+    breaker.record_failure()
+    assert breaker.allow() and breaker.allow() and breaker.allow()
+
+
+def test_round_exhaustion_feeds_the_global_breaker(stub_round):
+    assert retry.BREAKER.state() == "closed"
+    for _ in range(retry.BREAKER_THRESHOLD):
+        stub_round.extend(
+            RuntimeError("XLA runtime error") for _ in range(3)
+        )
+        with pytest.raises(retry.DeviceRoundError):
+            retry.run_round_guarded(
+                StubBridge(), cfg=None,
+                counters=retry.RoundCounters(), sleep=no_sleep,
+            )
+    assert retry.BREAKER.state() == "open"
+    # an open breaker turns solver device dispatch off too
+    assert retry.BREAKER.open
