@@ -272,6 +272,8 @@ def _solver_delta(base: dict) -> dict:
         "solver_cache_hits": hits,
         "solver_queries": queries,
         "z3_fallback_inflight_p95": now["inflight_p95"],
+        "static_unsat_seeds": now["static_unsat_seeds"]
+        - base["static_unsat_seeds"],
     }
 
 
@@ -313,6 +315,12 @@ def _emit(progress: dict) -> None:
                     "z3_fallback_inflight_p95"
                 ),
                 "static_pass_s": progress.get("static_pass_s"),
+                "taint_pass_s": progress.get("taint_pass_s"),
+                "hook_dispatches_skipped": progress.get(
+                    "hook_dispatches_skipped"
+                ),
+                "hook_dispatches": progress.get("hook_dispatches"),
+                "static_unsat_seeds": progress.get("static_unsat_seeds"),
                 "static_pruned_lanes": progress.get("static_pruned_lanes"),
                 "integrated_static_pruned_lanes": progress.get(
                     "integrated_static_pruned_lanes"
@@ -613,8 +621,16 @@ def main() -> int:
     # children it pruned on the north-star BECToken row
     progress["static_pruned_lanes"] = bec_pruned
     from mythril_tpu.analysis import static_pass
+    from mythril_tpu.analysis.module import gating
 
     progress["static_pass_s"] = round(static_pass.stats()["wall_s"], 4)
+    # stage-2 share of the pass, and the hook-dispatch gate's cumulative
+    # skip counters (docs/TAINT_PASS.md: a gate may skip work, never an
+    # issue) across every analysis in this process
+    progress["taint_pass_s"] = round(static_pass.stats()["taint_wall_s"], 4)
+    gate_stats = gating.stats()
+    progress["hook_dispatches_skipped"] = gate_stats["skipped"]
+    progress["hook_dispatches"] = gate_stats["dispatched"]
     _checkpoint(progress)
     _phase("done")
 

@@ -1,0 +1,25 @@
+; Unprotected SELFDESTRUCT (SWC-106): anyone who sends the kill()
+; selector reaches SELFDESTRUCT with no authorization check — the
+; classic "accidentally killable" contract (reference:
+; solidity_examples/suicide.sol; no solc in this image, so the pattern
+; is authored directly in EVM assembly).
+;
+; Static-pass goldens (tests/analysis/test_taint_pass.py): the JUMPI
+; condition is calldata-tainted, the SELFDESTRUCT pc carries the
+; SWC-106 candidate-mask bit and the AccidentallyKillable relevance
+; bit, and no other pc does.
+
+PUSH1 0x00
+CALLDATALOAD
+PUSH1 0xE0
+SHR                     ; [selector]
+PUSH4 0x41c0e1b5        ; kill()
+EQ
+PUSH2 :kill
+JUMPI
+STOP
+
+kill:
+JUMPDEST
+CALLER                  ; beneficiary: whoever calls
+SELFDESTRUCT

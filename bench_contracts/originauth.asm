@@ -1,0 +1,25 @@
+; Authorization through tx.origin (SWC-115): the owner check compares
+; ORIGIN — not CALLER — against a constant, so a phishing contract
+; invoked by the owner passes the guard (reference:
+; solidity_examples/origin.sol; authored directly in EVM assembly).
+;
+; Static-pass goldens (tests/analysis/test_taint_pass.py): ORIGIN
+; taint flows through EQ into the JUMPI condition, so the JUMPI pc
+; carries the SWC-115 candidate-mask bit and the TxOrigin relevance
+; bit alongside the ORIGIN pc itself.
+
+ORIGIN
+PUSH20 0xaffeaffeaffeaffeaffeaffeaffeaffeaffeaffe
+EQ
+PUSH2 :ok
+JUMPI
+PUSH1 0x00
+PUSH1 0x00
+REVERT
+
+ok:
+JUMPDEST
+PUSH1 0x01
+PUSH1 0x00
+SSTORE                  ; privileged write behind the origin guard
+STOP

@@ -7,6 +7,7 @@ pre/post hook declarations (opcode names, or prefix wildcards such as
 import logging
 from typing import Callable, Dict, List, Optional
 
+from mythril_tpu.analysis.module import gating
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
 from mythril_tpu.analysis.module.loader import ModuleLoader
 from mythril_tpu.support.opcodes import NAME_SPECS
@@ -33,6 +34,14 @@ def get_detection_module_hooks(
     hooks: Dict[str, List[Callable]] = {}
     for module in modules:
         declared = module.pre_hooks if hook_type == "pre" else module.post_hooks
+        # pre-hooks dispatch through the static-fact gate (gating.py):
+        # statically irrelevant pcs are skipped, everything else (and
+        # every post-hook) runs unchanged
+        callback = (
+            gating.wrap_pre_hook(module)
+            if hook_type == "pre"
+            else module.execute
+        )
         for pattern in declared:
             expanded = _expand(pattern)
             if not expanded:
@@ -42,7 +51,7 @@ def get_detection_module_hooks(
                     module.name,
                 )
             for opcode in expanded:
-                hooks.setdefault(opcode, []).append(module.execute)
+                hooks.setdefault(opcode, []).append(callback)
     return hooks
 
 

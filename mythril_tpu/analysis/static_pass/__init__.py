@@ -9,17 +9,25 @@ Runs ONCE per contract before symbolic execution:
    over-approximate successor table (absint.py);
 3. per-block facts — reachability from dispatch, static stack delta,
    interesting-op distance, must-revert/dead blocks — exported as dense
-   NumPy tables (tables.py).
+   NumPy tables (tables.py);
+4. a second, flow-sensitive stage (dataflow.py + taint.py): taint
+   reachability from calldata/ORIGIN/call returns, storage-effect and
+   call-ordering summaries, value intervals, and the per-PC
+   detector-relevance / SWC candidate planes built from them.
 
 Consumers: laser/tpu/batch.py make_code_bank (device jumpdest +
-must-revert bitmaps), laser/evm/instructions.py (host JUMP/JUMPI fast
-path over resolved targets), laser/evm/strategy/basic.py
-(StaticDistanceWeightedStrategy), and the detection probe (probe.py).
+must-revert + swc_mask bitmaps), laser/evm/instructions.py (host
+JUMP/JUMPI fast path over resolved targets), laser/evm/strategy/basic.py
+(StaticDistanceWeightedStrategy), the detection probe (probe.py), the
+hook-dispatch gate (analysis/module/gating.py), and the solver cache's
+static must-UNSAT seeding (laser/tpu/solver_cache.py via bridge.py).
 
 Results are cached per bytecode; ``stats()`` exposes the cumulative
-analysis wall time for the bench protocol (``static_pass_s``).
+analysis wall time for the bench protocol (``static_pass_s`` /
+``taint_pass_s``).
 
-See docs/STATIC_PASS.md for the lattice and the soundness argument.
+See docs/STATIC_PASS.md and docs/TAINT_PASS.md for the lattices and the
+soundness arguments.
 """
 
 import time
@@ -33,17 +41,34 @@ from mythril_tpu.analysis.static_pass.blocks import (
     decompose,
     scan,
 )
+from mythril_tpu.analysis.static_pass import taint as _taint
 from mythril_tpu.analysis.static_pass.tables import (
+    FACT_SCHEMA_VERSION,
     INTEREST_INF,
     MAX_SUCC,
     StaticAnalysis,
     build,
 )
+from mythril_tpu.analysis.static_pass.taint import (
+    FACT_BITS,
+    SWC_MASK_BITS,
+    TAINT_ALL,
+    TAINT_CALLDATA,
+    TAINT_CALLRET,
+    TAINT_ORIGIN,
+)
 
 __all__ = [
+    "FACT_BITS",
+    "FACT_SCHEMA_VERSION",
     "INTERESTING",
     "INTEREST_INF",
     "MAX_SUCC",
+    "SWC_MASK_BITS",
+    "TAINT_ALL",
+    "TAINT_CALLDATA",
+    "TAINT_CALLRET",
+    "TAINT_ORIGIN",
     "BasicBlock",
     "Insn",
     "StaticAnalysis",
@@ -88,9 +113,14 @@ def analyze(code: Union[bytes, bytearray, str]) -> StaticAnalysis:
 
 
 def stats() -> dict:
-    """Cumulative pass cost counters (bench protocol: static_pass_s)."""
-    return dict(_STATS)
+    """Cumulative pass cost counters (bench protocol: static_pass_s /
+    taint_pass_s). ``taint_wall_s`` is the stage-2 share of ``wall_s``
+    (taint.compute runs inside build, so it is included in both)."""
+    out = dict(_STATS)
+    out["taint_wall_s"] = _taint.stats()["wall_s"]
+    return out
 
 
 def reset_stats() -> None:
     _STATS.update(wall_s=0.0, contracts=0, cache_hits=0)
+    _taint.reset_stats()

@@ -16,7 +16,7 @@ from typing import Dict, List, NamedTuple, Optional, Set, Tuple
 
 import numpy as np
 
-from mythril_tpu.analysis.static_pass import absint
+from mythril_tpu.analysis.static_pass import absint, taint
 from mythril_tpu.analysis.static_pass.blocks import (
     INTERESTING,
     INVALID,
@@ -32,6 +32,16 @@ from mythril_tpu.support.opcodes import OPCODES
 
 # sentinel distance for "no interesting op reachable from here"
 INTEREST_INF = 1 << 30
+
+# Version of the fact-table schema. Bump whenever the meaning, layout,
+# or derivation of any StaticAnalysis plane changes: service/cache.py
+# folds this into its parameter match so result entries (and the
+# detector dedup state they captured) built against older fact tables
+# miss instead of resurrecting stale verdicts.
+#   1 = PR 1 CFG/absint planes
+#   2 = taint/interval stage (taint_mask, jumpi_verdict, effect_flags,
+#       module_relevance, swc_mask)
+FACT_SCHEMA_VERSION = 2
 
 # successor-table column cap: blocks with more resolved destinations
 # (huge dispatchers) overflow into succ_unknown, which stays sound
@@ -87,6 +97,14 @@ class StaticAnalysis(NamedTuple):
     resolved_target: np.ndarray  # i32[code_len]
     has_unresolved_jumps: bool
     has_truncated_push: bool
+    # stage-2 fact planes (taint.py; see docs/TAINT_PASS.md). taint_mask
+    # and module_relevance are MAY facts (over-approximations — a clear
+    # bit proves absence); jumpi_verdict holds MUST branch facts
+    taint_mask: np.ndarray  # u8[code_len]
+    jumpi_verdict: np.ndarray  # i8[code_len]
+    effect_flags: np.ndarray  # u8[n_blocks]
+    module_relevance: np.ndarray  # u32[code_len]
+    swc_mask: np.ndarray  # u8[code_len]
 
     @property
     def n_blocks(self) -> int:
@@ -277,6 +295,17 @@ def build(code: bytes) -> StaticAnalysis:
     has_unresolved = bool(succ_unknown.any())
     has_truncated = any(insn.truncated for insn in insns)
 
+    taint_facts = taint.compute(
+        tuple(insns),
+        tuple(blocks),
+        block_of_map,
+        jumpdests,
+        code_len,
+        succ_sets,
+        succ_unknown,
+        jumpdest_blocks,
+    )
+
     return StaticAnalysis(
         code_len=code_len,
         insns=tuple(insns),
@@ -296,4 +325,9 @@ def build(code: bytes) -> StaticAnalysis:
         resolved_target=resolved_target,
         has_unresolved_jumps=has_unresolved,
         has_truncated_push=has_truncated,
+        taint_mask=taint_facts.taint_mask,
+        jumpi_verdict=taint_facts.jumpi_verdict,
+        effect_flags=taint_facts.effect_flags,
+        module_relevance=taint_facts.module_relevance,
+        swc_mask=taint_facts.swc_mask,
     )

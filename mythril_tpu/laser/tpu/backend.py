@@ -33,6 +33,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from mythril_tpu.analysis import static_pass
 from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.strategy import BasicSearchStrategy
 from mythril_tpu.laser.tpu.batch import (
@@ -128,6 +129,11 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # and continued their packed states on the host path
         self.device_retries = 0
         self.degraded_rounds = 0
+        # device-side SWC candidate sites: statically-flagged pcs
+        # (CodeBank.swc_mask) some device lane actually visited this
+        # analysis, keyed by SWC id. Candidates, not findings — the host
+        # detection modules are the authoritative confirm at lift time
+        self.swc_candidate_sites = {swc: 0 for swc in static_pass.SWC_MASK_BITS}
         # solver-cache accounting baseline: the cache is process-global
         # (verdicts legitimately outlive one analysis), so per-analysis
         # counters are deltas against the construction-time snapshot
@@ -154,6 +160,8 @@ class TpuBatchStrategy(BasicSearchStrategy):
             "solver_cache_hit_rate": (hits / queries) if queries else 0.0,
             "solver_time_s": now["time_s"] - base["time_s"],
             "z3_fallback_inflight_p95": now["inflight_p95"],
+            "static_unsat_seeds": now["static_unsat_seeds"]
+            - base["static_unsat_seeds"],
         }
 
     @property
@@ -767,8 +775,19 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
             [c.raw for c in s.world_state.constraints] for s in undecided
         ]
         hints = [getattr(s, "_solver_prefix_fps", None) for s in undecided]
+        # static must-UNSAT seeds: lanes the bridge flagged because their
+        # retired path took a branch direction the interval analysis
+        # proves impossible (tables.jumpi_verdict) are decided UNSAT
+        # without touching the memo or the device
+        static_unsat = [
+            bool(getattr(s, "_static_unsat", False)) for s in undecided
+        ]
         verdicts = solver_cache.GLOBAL.decide_batch(
-            sets, use_device=use_device, flips=SOLVE_FLIPS, hints=hints
+            sets,
+            use_device=use_device,
+            flips=SOLVE_FLIPS,
+            hints=hints,
+            static_unsat=static_unsat if any(static_unsat) else None,
         )
         for s, verdict in zip(undecided, verdicts):
             s.world_state.constraints.seed_feasibility(
@@ -1211,6 +1230,32 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                     continue
                 for hook in laser._device_coverage_hooks:
                     hook(code_bytes.hex(), offsets.tolist())
+
+        # device-side SWC candidate masks: join the static pass's per-pc
+        # swc_mask plane (lifted into CodeBank.swc_mask) against the pcs
+        # device lanes of THIS job actually visited. Candidates only —
+        # the host detection modules remain the authoritative confirm;
+        # this feeds bench/service counters, never a report.
+        swc_visited = np.asarray(out.visited)
+        swc_code_ids = np.asarray(out.code_id)
+        for code_id, code_bytes in enumerate(bridge.codes):
+            lanes_mask = own_alive & (swc_code_ids == code_id)
+            if not lanes_mask.any():
+                continue
+            try:
+                mask = static_pass.analyze(code_bytes).swc_mask
+            except Exception as e:  # pragma: no cover - analysis degrade
+                log.debug("swc harvest: static pass failed: %s", e)
+                continue
+            width = min(len(mask), swc_visited.shape[1])
+            union = swc_visited[lanes_mask][:, :width].any(axis=0)
+            hit = mask[:width][union]
+            if hit.size == 0:
+                continue
+            for swc, bit in static_pass.SWC_MASK_BITS.items():
+                strategy.swc_candidate_sites[swc] += int(
+                    np.count_nonzero(hit & bit)
+                )
 
         status = np.asarray(out.status)
         resumed_states = []

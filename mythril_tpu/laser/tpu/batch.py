@@ -115,6 +115,12 @@ class CodeBank(NamedTuple):
     # instead of forked (engine.py) — the host never sees the lane.
     must_revert: jnp.ndarray  # bool[n_codes, code_len]
     prune_revert: jnp.ndarray  # bool[] scalar
+    # static SWC candidate bits per byte-pc (analysis/static_pass/taint
+    # SWC_MASK_*): the kernel does not branch on this plane — the
+    # backend joins it host-side against the visited plane after each
+    # round to surface device-side candidate sites per SWC class, with
+    # the host detection modules as the authoritative confirm
+    swc_mask: jnp.ndarray  # u8[n_codes, code_len]
 
 
 class Env(NamedTuple):
@@ -332,6 +338,7 @@ def make_code_bank(
     lens = np.zeros((n,), dtype=np.int32)
     jd = np.zeros((n, code_len), dtype=bool)
     mrev = np.zeros((n, code_len), dtype=bool)
+    swc = np.zeros((n, code_len), dtype=np.uint8)
     pimm = np.zeros((n, code_len, words.NDIGITS), dtype=np.uint32)
     for i, c in enumerate(codes):
         if len(c) > code_len:
@@ -341,6 +348,7 @@ def make_code_bank(
         analysis = static_pass.analyze(bytes(c))
         jd[i, : len(c)] = analysis.jumpdest_bitmap
         mrev[i, : len(c)] = analysis.must_revert_pc
+        swc[i, : len(c)] = analysis.swc_mask
         # Pre-decode PUSH immediates (truncated pushes zero-pad on the
         # right, matching the EVM's implicit zero bytes past code end).
         pc = 0
@@ -366,6 +374,7 @@ def make_code_bank(
         record_storage_events=jnp.asarray(bool(record_storage_events)),
         must_revert=jnp.asarray(mrev),
         prune_revert=jnp.asarray(bool(prune_revert)),
+        swc_mask=jnp.asarray(swc),
     )
 
 

@@ -1072,6 +1072,29 @@ class DeviceBridge:
                 fps = symtape.path_fingerprint(h1, h2, signs)
                 gs._solver_prefix_fps = tuple(int(f) for f in fps)
 
+        # static must-fact contradiction: a device branch whose recorded
+        # sign conflicts with the taint pass's MUST verdict at that JUMPI
+        # cannot be satisfied, so the whole path condition is UNSAT. The
+        # flag rides to filter_feasible, which seeds the solver cache
+        # (static_unsat_seeds) instead of spending a solve on the lane.
+        if plen:
+            analysis = getattr(gs.environment.code, "static_analysis", None)
+            verdict_plane = getattr(analysis, "jumpi_verdict", None)
+            if verdict_plane is not None:
+                metas = np.asarray(st.path_meta)[lane]
+                path_signs = np.asarray(st.path_sign)[lane]
+                for j in range(plen):
+                    site = symtape.unpack_meta(int(metas[j]))
+                    if site is None or not 0 <= site[0] < analysis.code_len:
+                        continue
+                    verdict = int(verdict_plane[site[0]])
+                    taken = bool(path_signs[j])
+                    if (verdict == 1 and not taken) or (
+                        verdict == 2 and taken
+                    ):
+                        gs._static_unsat = True
+                        break
+
         self._replay_jumpi_sites(gs, st, lane, values)
         self._replay_segment_sites(gs, st, lane, values)
         return gs
@@ -1207,6 +1230,10 @@ class DeviceBridge:
         plen = int(np.asarray(st.path_len)[lane])
         if plen == 0:
             return
+        from mythril_tpu.analysis.module import gating
+
+        analysis = getattr(gs.environment.code, "static_analysis", None)
+        depth_ok = len(gs.transaction_stack) <= 1
         path_ids = np.asarray(st.path_id)[lane]
         path_metas = np.asarray(st.path_meta)[lane]
         instr_list = gs.environment.code.instruction_list
@@ -1230,6 +1257,10 @@ class DeviceBridge:
                 )
                 with forced_hook_phase(prehook=True):
                     for module, _name in replayers:
+                        if not gating.gate_replay(
+                            module, analysis, pc_byte, depth_ok
+                        ):
+                            continue
                         try:
                             module.execute(gs)
                         except Exception as e:  # pragma: no cover

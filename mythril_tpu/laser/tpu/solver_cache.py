@@ -495,6 +495,7 @@ _STAT_KEYS = (
     "async_submitted",
     "async_completed",
     "async_dropped",
+    "static_unsat_seeds",
 )
 
 
@@ -661,6 +662,7 @@ class SolverCache:
         flips: int = 384,
         hints: Optional[Sequence] = None,
         host_fallback: bool = True,
+        static_unsat: Optional[Sequence[bool]] = None,
     ) -> List[Optional[bool]]:
         """Decide a frontier of constraint sets: memo -> device batch ->
         inline quick host check -> async pool.
@@ -671,6 +673,12 @@ class SolverCache:
         the lane's descendants). ``host_fallback=False`` stops after
         the device dispatch (the lazy-screen triage path: unknown parks
         go to settlement, not to the host).
+
+        ``static_unsat[i]`` marks sets the static taint pass proved
+        contradictory (a MUST branch-verdict conflicting with the lane's
+        recorded branch sign): they short-circuit to False without any
+        solve, and the UNSAT is recorded so subsumption prunes the
+        lane's descendants too.
 
         Host economics: when the device DID run, its residue goes to
         the ASYNC pool only (and only in service mode — see _pool_armed)
@@ -693,6 +701,14 @@ class SolverCache:
         decided = [False] * n
         pending: List[int] = []
         for i, cs in enumerate(sets):
+            if static_unsat is not None and static_unsat[i]:
+                # statically proven contradiction: no lookup, no solve;
+                # record the UNSAT so supersets subsume without re-proof
+                verdicts[i] = False
+                decided[i] = True
+                self._count("static_unsat_seeds")
+                self.record(cs, UNSAT)
+                continue
             code, key, digest = self._lookup(cs)
             keys[i] = key
             digests[i] = digest

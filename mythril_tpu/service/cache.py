@@ -50,8 +50,15 @@ def cache_key(creation_hex: str, runtime_hex: str) -> bytes:
 def _normalize_params(
     tx_count: int, modules: Optional[List[str]], timeout: Optional[float]
 ) -> Tuple:
+    # FACT_SCHEMA_VERSION participates in parameter equality: an entry's
+    # stored static-pass tables (and any detector results that were
+    # gated/deduped against them) are only valid for the fact-table
+    # schema they were computed under — bumping the schema invalidates
+    # every cached report, exactly like changing any other parameter
+    from mythril_tpu.analysis.static_pass import FACT_SCHEMA_VERSION
+
     mods = tuple(sorted(modules)) if modules else None
-    return (int(tx_count), mods, timeout)
+    return (int(tx_count), mods, timeout, FACT_SCHEMA_VERSION)
 
 
 class CacheEntry:

@@ -15,6 +15,25 @@ export PYTHONPATH=
 echo "== byte-compile =="
 python -m compileall -q mythril_tpu tests scripts bench.py __graft_entry__.py
 
+echo "== package hygiene =="
+# every Python package directory under mythril_tpu/ must contain at
+# least one tracked source file — a dir holding only __pycache__ is a
+# stale remnant of a deleted package and shadows future imports. Dirs
+# with no .py surface at all (e.g. _build/ native artifacts) are not
+# packages and are left alone.
+stale=0
+while IFS= read -r dir; do
+    if [ -n "$(git ls-files "$dir")" ]; then
+        continue
+    fi
+    if [ -d "$dir/__pycache__" ] || compgen -G "$dir/*.py" > /dev/null; then
+        echo "stale package (no tracked files): $dir"
+        stale=1
+    fi
+done < <(find mythril_tpu -type d -not -name __pycache__)
+[ "$stale" -eq 0 ] || exit 1
+echo "package hygiene ok"
+
 echo "== lint =="
 python scripts/lint.py
 
@@ -26,6 +45,7 @@ echo "== static-pass golden tests =="
 # -k keeps this to the fast fixture/decode tests; the symbolic-execution
 # property tests in the same files run with the full suite
 python -m pytest tests/analysis/test_static_pass.py \
+    tests/analysis/test_taint_pass.py \
     tests/analysis/test_disassembler_truncated.py \
     -q -p no:cacheprovider -k "golden or cache or push or scan"
 

@@ -492,6 +492,131 @@ def seam_exceptions(tree: ast.AST, source: str, rel: str):
     return sorted(set(out))
 
 
+def _swc_registry():
+    """(constant name -> id string, set of valid SWC id strings) from
+    analysis/swc_data.py (module-level string assignments + the
+    SWC_TO_TITLE key set)."""
+    tree = ast.parse((REPO / "mythril_tpu/analysis/swc_data.py").read_text())
+    consts = {}
+    valid = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, str
+        ):
+            consts[target.id] = node.value.value
+        elif target.id == "SWC_TO_TITLE" and isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    valid.add(key.value)
+    return consts, valid
+
+
+def _resolve_swc_ids(expr, consts):
+    """The SWC id strings an ``swc_id = <expr>`` declaration names, or
+    None when the expression shape isn't statically resolvable. Handles
+    the three shapes in the tree: a string literal, a swc_data constant
+    name, and ``"{} {}".format(CONST, CONST)`` composites."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value.split()
+    if isinstance(expr, ast.Name):
+        value = consts.get(expr.id)
+        return value.split() if value is not None else None
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "format"
+        and not expr.keywords
+    ):
+        out = []
+        for arg in expr.args:
+            sub = _resolve_swc_ids(arg, consts)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    return None
+
+
+def swc_declared():
+    """Cross-file rule: every detection-module class under
+    analysis/module/modules/ must declare an ``swc_id`` that resolves to
+    ids present in swc_data.SWC_TO_TITLE, and every static-fact gate bit
+    (static_pass/taint.py FACT_BITS) must name a declared module class —
+    a renamed module would otherwise silently un-gate (harmless) or,
+    worse, a stale bit could gate the wrong module."""
+    consts, valid = _swc_registry()
+    problems = []
+    module_classes = set()
+    modules_dir = REPO / "mythril_tpu/analysis/module/modules"
+    for path in sorted(modules_dir.glob("*.py")):
+        rel = path.relative_to(REPO)
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {
+                b.id for b in node.bases if isinstance(b, ast.Name)
+            }
+            if not bases & {"DetectionModule", "ProbeModule"}:
+                continue
+            module_classes.add(node.name)
+            decl = None
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "swc_id"
+                ):
+                    decl = stmt
+            if decl is None:
+                problems.append(
+                    f"{rel}:{node.lineno}: detection module "
+                    f"'{node.name}' declares no swc_id"
+                )
+                continue
+            ids = _resolve_swc_ids(decl.value, consts)
+            if ids is None:
+                problems.append(
+                    f"{rel}:{decl.lineno}: swc_id of '{node.name}' is "
+                    "not statically resolvable against swc_data.py"
+                )
+                continue
+            for swc in ids:
+                if swc not in valid:
+                    problems.append(
+                        f"{rel}:{decl.lineno}: swc_id '{swc}' of "
+                        f"'{node.name}' is not in swc_data.SWC_TO_TITLE"
+                    )
+    taint_rel = "mythril_tpu/analysis/static_pass/taint.py"
+    tree = ast.parse((REPO / taint_rel).read_text())
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "FACT_BITS"
+            and isinstance(node.value, ast.Dict)
+        ):
+            for key in node.value.keys:
+                if (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and key.value not in module_classes
+                ):
+                    problems.append(
+                        f"{taint_rel}:{key.lineno}: FACT_BITS names "
+                        f"'{key.value}', which is not a declared "
+                        "detection module class"
+                    )
+    return problems
+
+
 def main() -> int:
     problems = []
     n_files = 0
@@ -529,6 +654,7 @@ def main() -> int:
                 problems.append(f"{rel}:{i}: tab in indentation")
         if source and not source.endswith("\n"):
             problems.append(f"{rel}: no newline at end of file")
+    problems.extend(swc_declared())
     for problem in problems:
         print(problem)
     print(f"lint: {len(problems)} problem(s) in {n_files} files")

@@ -68,3 +68,11 @@ def _fresh_solver_cache():
     _solver_cache.reset_for_tests()
     yield
     _solver_cache.reset_for_tests()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long symbolic-execution runs excluded from the tier-1 "
+        "gate (pytest -m 'not slow')",
+    )

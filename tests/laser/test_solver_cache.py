@@ -132,6 +132,32 @@ class TestMemoization:
         code, _ = cache.lookup(superset)
         assert code == sc.UNSAT and cache.stats()["hits_exact"] == 1
 
+    def test_static_unsat_seed_short_circuits(self, monkeypatch):
+        """A set the static taint pass proved contradictory (must-take
+        branch recorded with the fall-through sign) is decided False
+        with no lookup and no solve, and the recorded UNSAT subsumes
+        the lane's descendant sets."""
+        cache = sc.SolverCache()
+        a = bv("su_a")
+        seeded = [(a == val(7)).raw]
+        other = [ULT(a, val(9)).raw]
+        calls, fake = counting_host_check(sc.SAT)
+        monkeypatch.setattr(sc, "_host_check", fake)
+        out = cache.decide_batch(
+            [seeded, other],
+            use_device=False,
+            static_unsat=[True, False],
+        )
+        assert out == [False, True]
+        assert len(calls) == 1  # only the unseeded set was solved
+        s = cache.stats()
+        assert s["static_unsat_seeds"] == 1
+        # descendants (supersets) of the seeded set are subsumed free
+        child = seeded + [ULT(a, val(50)).raw]
+        assert cache.decide_batch([child], use_device=False) == [False]
+        assert len(calls) == 1
+        assert cache.stats()["hits_subsume"] == 1
+
     def test_alpha_hit_across_renaming(self, monkeypatch):
         cache = sc.SolverCache()
         left = formulas("mla", 51, count=4)

@@ -59,3 +59,23 @@ def test_hit_reseeds_static_pass_cache():
     assert code not in static_pass._CACHE
     assert cache.get(key, 1, None, None) is not None
     assert static_pass._CACHE[code] is tables
+
+
+def test_fact_schema_version_invalidates_entries(monkeypatch):
+    """An entry stored under one static fact-table schema must not
+    answer a lookup after the schema is bumped: the stored tables (and
+    any results deduped/gated against them) have the old layout."""
+    from mythril_tpu.service import cache as cache_mod
+
+    cache = ResultCache()
+    key = cache_key("", "6000")
+    cache.put(key, 1, None, None, [], [], cold_wall_s=0.0)
+    assert cache.get(key, 1, None, None) is not None
+    monkeypatch.setattr(
+        static_pass, "FACT_SCHEMA_VERSION", static_pass.FACT_SCHEMA_VERSION + 1
+    )
+    assert cache.get(key, 1, None, None) is None
+    # and the version participates in the normalized parameter tuple
+    assert static_pass.FACT_SCHEMA_VERSION in cache_mod._normalize_params(
+        1, None, None
+    )
