@@ -325,6 +325,9 @@ def _emit(progress: dict) -> None:
                 "integrated_static_pruned_lanes": progress.get(
                     "integrated_static_pruned_lanes"
                 ),
+                "trace_overhead_pct": progress.get("trace_overhead_pct"),
+                "round_phase_p50_ms": progress.get("round_phase_p50_ms"),
+                "round_phase_p95_ms": progress.get("round_phase_p95_ms"),
                 "lanes": progress.get("lanes"),
                 "platform": progress.get("platform", "unknown"),
                 "partial": progress.get("partial", False),
@@ -631,6 +634,45 @@ def main() -> int:
     gate_stats = gating.stats()
     progress["hook_dispatches_skipped"] = gate_stats["skipped"]
     progress["hook_dispatches"] = gate_stats["dispatched"]
+    _checkpoint(progress)
+
+    # observability cost/visibility row (docs/OBSERVABILITY.md): the
+    # stress pipeline again with the span tracer live, against the
+    # untraced run above (<5%% regression is the acceptance bar), plus
+    # per-phase latency quantiles from the round-phase histogram
+    # accumulated over this process's integrated runs
+    _phase("traced re-run (stress contract, tx=2 budget=60)")
+    from mythril_tpu import obs
+    from mythril_tpu.obs import catalog as obs_catalog
+
+    obs.TRACER.enable()
+    try:
+        traced_meter, _, _ = _steady_analysis(
+            creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
+        )
+    finally:
+        obs.TRACER.disable()
+        obs.TRACER.clear()
+    untraced = progress["integrated_states_per_sec"]
+    traced = traced_meter.states_per_s
+    progress["traced_states_per_sec"] = traced
+    progress["trace_overhead_pct"] = (
+        None
+        if not untraced
+        else round((untraced - traced) / untraced * 100.0, 2)
+    )
+    hist = obs_catalog.ROUND_PHASE_S
+    p50, p95 = {}, {}
+    for labelvalues in hist.series_labelvalues():
+        phase_name = labelvalues[0]
+        v50 = hist.percentile(50, *labelvalues)
+        v95 = hist.percentile(95, *labelvalues)
+        if v50 is not None:
+            p50[phase_name] = round(v50 * 1000.0, 3)
+        if v95 is not None:
+            p95[phase_name] = round(v95 * 1000.0, 3)
+    progress["round_phase_p50_ms"] = p50
+    progress["round_phase_p95_ms"] = p95
     _checkpoint(progress)
     _phase("done")
 

@@ -25,14 +25,13 @@ import os
 from typing import Callable
 
 from mythril_tpu.analysis.static_pass.taint import FACT_BITS
+from mythril_tpu.obs import catalog as _cat
 
 # kill switch for A/B parity runs: MYTHRIL_TPU_HOOK_GATE=0 disables the
 # gate without touching the wrappers (dispatch counting stays live)
 _ENV_FLAG = "MYTHRIL_TPU_HOOK_GATE"
 
 _enabled = os.environ.get(_ENV_FLAG, "1") != "0"
-
-_STATS = {"dispatched": 0, "skipped": 0}
 
 
 def enabled() -> bool:
@@ -46,11 +45,16 @@ def set_enabled(value: bool) -> None:
 
 
 def stats() -> dict:
-    return dict(_STATS)
+    """Thin view over the obs registry (obs/catalog.py, ISSUE 9)."""
+    return {
+        "dispatched": int(_cat.HOOK_DISPATCHES_TOTAL.value()),
+        "skipped": int(_cat.HOOK_SKIPPED_TOTAL.value()),
+    }
 
 
 def reset_stats() -> None:
-    _STATS.update(dispatched=0, skipped=0)
+    _cat.HOOK_DISPATCHES_TOTAL.reset()
+    _cat.HOOK_SKIPPED_TOTAL.reset()
 
 
 def relevant(analysis, bit: int, pc: int) -> bool:
@@ -78,9 +82,9 @@ def gate_replay(module, analysis, pc: int, depth_ok: bool) -> bool:
         and bit is not None
         and not relevant(analysis, bit, pc)
     ):
-        _STATS["skipped"] += 1
+        _cat.HOOK_SKIPPED_TOTAL.inc()
         return False
-    _STATS["dispatched"] += 1
+    _cat.HOOK_DISPATCHES_TOTAL.inc()
     return True
 
 
@@ -98,7 +102,7 @@ def wrap_pre_hook(module) -> Callable:
     if bit is None:
 
         def counting(global_state):
-            _STATS["dispatched"] += 1
+            _cat.HOOK_DISPATCHES_TOTAL.inc()
             return execute(global_state)
 
         counting.__self__ = module
@@ -115,9 +119,9 @@ def wrap_pre_hook(module) -> Callable:
                 except IndexError:
                     pc = -1
                 if not relevant(analysis, bit, pc):
-                    _STATS["skipped"] += 1
+                    _cat.HOOK_SKIPPED_TOTAL.inc()
                     return None
-        _STATS["dispatched"] += 1
+        _cat.HOOK_DISPATCHES_TOTAL.inc()
         return execute(global_state)
 
     gated.__self__ = module

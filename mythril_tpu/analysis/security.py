@@ -5,8 +5,11 @@ finished statespace; CALLBACK modules already accumulated issues through
 their hooks and are drained (then reset) here."""
 
 import logging
+import time
 from typing import List, Optional
 
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _cat
 from mythril_tpu.analysis.module.base import EntryPoint
 from mythril_tpu.analysis.module.loader import ModuleLoader
 from mythril_tpu.analysis.module.util import reset_callback_modules
@@ -35,7 +38,10 @@ def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issu
         entry_point=EntryPoint.POST, white_list=white_list
     ):
         log.info("Executing %s", module.name)
-        collected.extend(module.execute(statespace) or [])
+        t0 = time.perf_counter()
+        with obs.TRACER.span("module", tid="module", module=module.name):
+            collected.extend(module.execute(statespace) or [])
+        _cat.MODULE_EXEC_S.inc(time.perf_counter() - t0, module.name)
     collected.extend(retrieve_callback_issues(white_list))
     return collected
 
@@ -80,6 +86,9 @@ def fire_lasers_for_job(
     for module in ModuleLoader().get_detection_modules(
         entry_point=EntryPoint.POST, white_list=white_list
     ):
-        collected.extend(module.execute(statespace) or [])
+        t0 = time.perf_counter()
+        with obs.TRACER.span("module", tid="module", module=module.name):
+            collected.extend(module.execute(statespace) or [])
+        _cat.MODULE_EXEC_S.inc(time.perf_counter() - t0, module.name)
     collected.extend(harvest_callback_issues(contract_names, white_list))
     return collected

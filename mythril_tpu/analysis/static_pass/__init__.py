@@ -34,6 +34,9 @@ import time
 from collections import OrderedDict
 from typing import Union
 
+from mythril_tpu import obs as _obs
+from mythril_tpu.obs import catalog as _cat
+
 from mythril_tpu.analysis.static_pass.blocks import (
     INTERESTING,
     BasicBlock,
@@ -85,8 +88,6 @@ __all__ = [
 _CACHE_CAP = 512
 _CACHE: "OrderedDict[bytes, StaticAnalysis]" = OrderedDict()
 
-_STATS = {"wall_s": 0.0, "contracts": 0, "cache_hits": 0}
-
 
 def _to_bytes(code: Union[bytes, bytearray, str]) -> bytes:
     if isinstance(code, str):
@@ -100,12 +101,13 @@ def analyze(code: Union[bytes, bytearray, str]) -> StaticAnalysis:
     hit = _CACHE.get(code)
     if hit is not None:
         _CACHE.move_to_end(code)
-        _STATS["cache_hits"] += 1
+        _cat.STATIC_CACHE_HITS_TOTAL.inc()
         return hit
     t0 = time.perf_counter()
-    result = build(code)
-    _STATS["wall_s"] += time.perf_counter() - t0
-    _STATS["contracts"] += 1
+    with _obs.TRACER.span("static_pass", tid="static", code_len=len(code)):
+        result = build(code)
+    _cat.STATIC_PASS_S.inc(time.perf_counter() - t0)
+    _cat.STATIC_CONTRACTS_TOTAL.inc()
     _CACHE[code] = result
     while len(_CACHE) > _CACHE_CAP:
         _CACHE.popitem(last=False)
@@ -115,12 +117,20 @@ def analyze(code: Union[bytes, bytearray, str]) -> StaticAnalysis:
 def stats() -> dict:
     """Cumulative pass cost counters (bench protocol: static_pass_s /
     taint_pass_s). ``taint_wall_s`` is the stage-2 share of ``wall_s``
-    (taint.compute runs inside build, so it is included in both)."""
-    out = dict(_STATS)
-    out["taint_wall_s"] = _taint.stats()["wall_s"]
-    return out
+    (taint.compute runs inside build, so it is included in both).
+
+    Thin view over the obs metrics registry (obs/catalog.py) — the
+    counters themselves live there since ISSUE 9."""
+    return {
+        "wall_s": _cat.STATIC_PASS_S.value(),
+        "contracts": int(_cat.STATIC_CONTRACTS_TOTAL.value()),
+        "cache_hits": int(_cat.STATIC_CACHE_HITS_TOTAL.value()),
+        "taint_wall_s": _taint.stats()["wall_s"],
+    }
 
 
 def reset_stats() -> None:
-    _STATS.update(wall_s=0.0, contracts=0, cache_hits=0)
+    _cat.STATIC_PASS_S.reset()
+    _cat.STATIC_CONTRACTS_TOTAL.reset()
+    _cat.STATIC_CACHE_HITS_TOTAL.reset()
     _taint.reset_stats()

@@ -34,6 +34,7 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from mythril_tpu.analysis.static_pass import dataflow
+from mythril_tpu.obs import catalog as _cat
 from mythril_tpu.analysis.static_pass.absint import _FOLD, MASK, MAX_TRACK
 from mythril_tpu.analysis.static_pass.blocks import (
     JUMP,
@@ -154,8 +155,6 @@ _SOURCE_SLOTS: Dict[int, Tuple[int, int, int]] = {
 
 _CMP_OPS = frozenset({0x10, 0x11, 0x12, 0x13, 0x14, 0x15})
 
-_STATS = {"wall_s": 0.0}
-
 
 def _arith_safe(
     op: int, a: Tuple[int, int, int], b: Tuple[int, int, int]
@@ -179,11 +178,12 @@ def _arith_safe(
 
 
 def stats() -> Dict[str, float]:
-    return dict(_STATS)
+    """Thin view over the obs registry (obs/catalog.py, ISSUE 9)."""
+    return {"wall_s": _cat.TAINT_PASS_S.value()}
 
 
 def reset_stats() -> None:
-    _STATS["wall_s"] = 0.0
+    _cat.TAINT_PASS_S.reset()
 
 
 def _interval(op: int, args: List[Tuple[int, int, int]]) -> Tuple[int, int]:
@@ -535,7 +535,7 @@ def compute(
         module_relevance[insn.pc] = rel
         swc_mask[insn.pc] = swc
 
-    _STATS["wall_s"] += time.perf_counter() - t0
+    _cat.TAINT_PASS_S.inc(time.perf_counter() - t0)
     return TaintFacts(
         taint_mask=taint_mask,
         jumpi_verdict=jumpi_verdict,

@@ -113,6 +113,12 @@ def add_analysis_flags(parser: argparse.ArgumentParser) -> None:
         metavar="DIR",
         help="write an open-state checkpoint after every transaction round",
     )
+    group.add_argument(
+        "--trace",
+        metavar="JSON_FILE",
+        help="record round-loop spans and write a Chrome trace-event "
+        "file (load in chrome://tracing or Perfetto)",
+    )
 
 
 # ------------------------------------------------------------------ plumbing
@@ -196,6 +202,23 @@ def _make_analyzer(source, args, address=None, use_onchain_data=False):
 
 def _run_analysis(analyzer, args) -> None:
     """Shared analysis tail: -g/-j exports or the full detection run."""
+    trace_path = getattr(args, "trace", None)
+    if trace_path:
+        from mythril_tpu import obs
+
+        obs.TRACER.enable()
+    try:
+        _run_analysis_inner(analyzer, args)
+    finally:
+        if trace_path:
+            n = obs.TRACER.export(trace_path)
+            print(
+                "wrote %d trace events to %s" % (n, trace_path),
+                file=sys.stderr,
+            )
+
+
+def _run_analysis_inner(analyzer, args) -> None:
     if args.graph:
         html = analyzer.graph_html(
             transaction_count=args.transaction_count,
@@ -447,6 +470,7 @@ def add_submit_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("--execution-timeout", type=int, default=60, metavar="SEC", help="per-job symbolic execution budget")
     group.add_argument("-m", "--modules", metavar="MODULES", help="comma-separated detection module whitelist")
     group.add_argument("--no-wait", action="store_true", help="print the job id and return without waiting for the result")
+    group.add_argument("--trace", metavar="JSON_FILE", help="ask the service for this job's span timeline and write it as a Chrome trace-event file")
 
 
 def run_submit(args) -> None:
@@ -472,6 +496,8 @@ def run_submit(args) -> None:
         request["creation_code"] = code
     if args.modules:
         request["modules"] = args.modules.split(",")
+    if args.trace:
+        request["trace"] = True
     response = request_over_socket(args.socket, request, timeout=30)
     if not response.get("ok"):
         raise CriticalError("submission rejected: %s" % response.get("error"))
@@ -481,7 +507,114 @@ def run_submit(args) -> None:
     result = request_over_socket(
         args.socket, {"op": "result", "job_id": response["job_id"]}
     )
+    if args.trace:
+        events = (result.get("result") or {}).pop("trace_events", [])
+        with open(args.trace, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f
+            )
+        print(
+            "wrote %d trace events to %s" % (len(events), args.trace),
+            file=sys.stderr,
+        )
     print(json.dumps(result, indent=2))
+
+
+def add_top_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("monitoring")
+    group.add_argument("--socket", metavar="PATH", required=True, help="socket of a running `myth serve --socket`")
+    group.add_argument("--interval", type=float, default=0.0, metavar="SEC", help="refresh every SEC seconds (default: print once and exit)")
+    group.add_argument("--count", type=int, default=0, metavar="N", help="with --interval: stop after N refreshes (default: until interrupted)")
+
+
+def _parse_prometheus(text: str) -> Dict[str, float]:
+    """Flatten exposition text to {name{labels}: value} (`myth top`
+    only needs point lookups, not a real scrape parser)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        try:
+            out[key] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _render_top(stats: Dict, prom: Dict[str, float]) -> str:
+    """One console frame: the operator's five questions (queue depth,
+    lanes resident, warm-cache rate, solver hit rate, degraded rounds)
+    answered on five lines."""
+
+    def rate(hits: float, total: float) -> str:
+        return "%.0f%%" % (100.0 * hits / total) if total else "-"
+
+    solver_q = prom.get("myth_solver_queries_total", 0.0)
+    solver_hits = sum(
+        v for k, v in prom.items()
+        if k.startswith("myth_solver_hits_total")
+    )
+    cache = stats.get("cache", {})
+    lines = [
+        "jobs      submitted %d   done %d   failed %d   cancelled %d   retried %d"
+        % (
+            stats.get("jobs_submitted", 0), stats.get("jobs_done", 0),
+            stats.get("jobs_failed", 0), stats.get("jobs_cancelled", 0),
+            stats.get("jobs_retried", 0),
+        ),
+        "queue     depth %d   resident peak %d   shared rounds %d/%d"
+        % (
+            stats.get("queued", 0), stats.get("max_resident_jobs", 0),
+            stats.get("shared_rounds", 0), stats.get("rounds", 0),
+        ),
+        "device    degraded rounds %d   retries %d   breaker %s (trips %d)"
+        % (
+            stats.get("degraded_rounds", 0), stats.get("device_retries", 0),
+            stats.get("breaker_state", "?"), stats.get("breaker_trips", 0),
+        ),
+        "caches    warm results %s (%d/%d, %d entries)   solver hits %s (%d/%d)"
+        % (
+            rate(cache.get("hits", 0), cache.get("hits", 0) + cache.get("misses", 0)),
+            cache.get("hits", 0),
+            cache.get("hits", 0) + cache.get("misses", 0),
+            cache.get("entries", 0),
+            rate(solver_hits, solver_q), solver_hits, solver_q,
+        ),
+        "safety    quarantined %d   checkpoints %d (%.2fs overhead)"
+        % (
+            stats.get("quarantined_jobs", 0), stats.get("checkpoints", 0),
+            stats.get("checkpoint_overhead_s", 0.0),
+        ),
+    ]
+    return "\n".join(lines)
+
+
+def run_top(args) -> None:
+    """Live service metrics console: one-shot by default, a refreshing
+    view with --interval (docs/OBSERVABILITY.md)."""
+    import time as _time
+
+    from mythril_tpu.service.api import request_over_socket
+
+    shown = 0
+    while True:
+        stats = request_over_socket(args.socket, {"op": "stats"}, timeout=10)
+        metrics = request_over_socket(args.socket, {"op": "metrics"}, timeout=10)
+        if not stats.get("ok") or not metrics.get("ok"):
+            raise CriticalError(
+                "service query failed: %s"
+                % (stats.get("error") or metrics.get("error"))
+            )
+        frame = _render_top(stats, _parse_prometheus(metrics["metrics"]))
+        if args.interval and shown:
+            print()
+        print(frame)
+        shown += 1
+        if not args.interval or (args.count and shown >= args.count):
+            return
+        _time.sleep(args.interval)
 
 
 # ------------------------------------------------------------------ registry
@@ -507,6 +640,11 @@ COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
         "Submits bytecode to a running analysis service",
         [add_submit_flags],
         run_submit,
+    ),
+    "top": (
+        "Shows live metrics from a running analysis service",
+        [add_top_flags],
+        run_top,
     ),
     "pro": (
         "Analyzes input with the MythX API (https://mythx.io)",

@@ -4,7 +4,10 @@ mythril/laser/ethereum/iprof.py), enabled by --enable-iprof.
 Host-executed instructions get exact per-call wall times. Instructions
 retired inside a batched device round have no individual timings, so
 the tpu-batch backend feeds per-opcode retire COUNTS plus the round's
-wall time; those render as an amortized section below the host one."""
+wall time; those are amortized (round wall / instructions retired) and
+merged into the same sorted per-op table as the host rows, so an opcode
+executed on both tiers shows both columns instead of the host row
+shadowing the device totals."""
 
 from collections import defaultdict
 from typing import Dict, List
@@ -30,26 +33,36 @@ class InstructionProfiler:
         self.device_time += wall_time
 
     def __repr__(self) -> str:
-        total = 0.0
+        host_total = sum(sum(d) for d in self.records.values())
+        retired = sum(self.device_counts.values())
+        amortized = self.device_time / max(retired, 1)
         lines = []
-        for op, durations in sorted(self.records.items()):
-            s = sum(durations)
-            total += s
-            lines.append(
-                "[%-12s] %.4f %%, nr %d, total %f s, avg %f s, min %f s, max %f s"
-                % (op, 0, len(durations), s, s / len(durations), min(durations), max(durations))
-            )
-        header = "Total: %f s\n" % total
+        # ONE sorted table over the union of host and device ops: a
+        # host-only row, a device-only row, or both columns side by side
+        for op in sorted(set(self.records) | set(self.device_counts)):
+            cols = []
+            durations = self.records.get(op)
+            if durations:
+                s = sum(durations)
+                cols.append(
+                    "host nr %d, total %f s, avg %f s, min %f s, max %f s"
+                    % (len(durations), s, s / len(durations),
+                       min(durations), max(durations))
+                )
+            dev_n = self.device_counts.get(op)
+            if dev_n:
+                cols.append(
+                    "device nr %d, ~%f s amortized" % (dev_n, dev_n * amortized)
+                )
+            lines.append("[%-12s] %s" % (op, ", ".join(cols)))
+        header = "Total: %f s (host %f s + device %f s)\n" % (
+            host_total + self.device_time, host_total, self.device_time,
+        )
         out = header + "\n".join(lines)
         if self.device_counts:
-            retired = sum(self.device_counts.values())
-            amortized = self.device_time / max(retired, 1)
-            dev_lines = [
-                "[%-12s] nr %d" % (op, n)
-                for op, n in sorted(self.device_counts.items())
-            ]
             out += (
                 "\nDevice rounds: %f s, %d instructions retired "
-                "(amortized %f s/instr)\n" % (self.device_time, retired, amortized)
-            ) + "\n".join(dev_lines)
+                "(amortized %f s/instr)"
+                % (self.device_time, retired, amortized)
+            )
         return out

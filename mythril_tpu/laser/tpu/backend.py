@@ -45,6 +45,8 @@ from mythril_tpu.laser.evm.plugins.signals import PluginSkipState
 from mythril_tpu.laser.tpu.bridge import DeviceBridge, PackError
 from mythril_tpu.laser.tpu.engine import run, run_with_stats
 from mythril_tpu.laser.tpu import solver_cache, solver_jax, symtape, transfer
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _cat
 from mythril_tpu.robustness import retry as _retry
 from mythril_tpu.support.opcodes import OPCODES
 
@@ -686,6 +688,7 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
 
         hist = None
         for _ in range(0, DEVICE_STEP_BUDGET, DEVICE_SLICE_STEPS):
+            _cat.DEVICE_SLICES_TOTAL.inc()
             if want_stats:
                 st, slice_hist = run_with_stats(
                     cb, default_env(), st, max_steps=DEVICE_SLICE_STEPS
@@ -713,6 +716,7 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
     cb, env = mesh_lib.put_replicated((cb, default_env()), mesh)
     steps_done = 0
     while steps_done < DEVICE_STEP_BUDGET:
+        _cat.DEVICE_SLICES_TOTAL.inc()
         do_reb = mesh_lib.should_rebalance(st, n_shards)
         st = mesh_lib.sharded_round(
             cb,
@@ -1016,7 +1020,15 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         # start compiling it alongside the plain variant
         warmup_device_async(cfg, want_stats=True)
 
+    # observability: jobs render as trace process rows (pid 0 =
+    # single-tenant), rounds as sequential "cut" spans that survive the
+    # loop body's continue/early-return paths (obs/trace.py)
+    _pid = job_ctx.job_id if job_ctx is not None else 0
+    _round_no = 0
+
     while laser.work_list:
+        _round_no += 1
+        obs.TRACER.cut("round", "round", pid=_pid, round=_round_no)
         if budget_deadline is not None and time.time() >= budget_deadline:
             log.debug("Hit execution timeout in tpu-batch loop, returning.")
             # keep the in-flight frontier: the host loop's timeout path
@@ -1035,13 +1047,14 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         # exactly as in the host loop (reference svm.py exec).
         pending = list(laser.strategy)
         produced: List[tuple] = []  # (state, new_states, op_code)
-        for global_state in pending:
-            try:
-                new_states, op_code = laser.execute_state(global_state)
-            except NotImplementedError:
-                log.debug("Encountered unimplemented instruction")
-                continue
-            produced.append((global_state, new_states, op_code))
+        with obs.phase("host_exec", pid=_pid, states=len(pending)):
+            for global_state in pending:
+                try:
+                    new_states, op_code = laser.execute_state(global_state)
+                except NotImplementedError:
+                    log.debug("Encountered unimplemented instruction")
+                    continue
+                produced.append((global_state, new_states, op_code))
         # pre-engagement the analysis must behave like the pure host
         # loop — including NO device feasibility dispatches (measured
         # r5: they alone cost the suicide+origin row ~25%); the survivor
@@ -1050,7 +1063,10 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         engaged = strategy.engaged()
         if engaged:
             # feasibility for the whole successor frontier in one call
-            filter_feasible([s for _, states, _ in produced for s in states])
+            with obs.phase("solve", pid=_pid):
+                filter_feasible(
+                    [s for _, states, _ in produced for s in states]
+                )
         survivors = []
         for global_state, new_states, op_code in produced:
             new_states = [
@@ -1086,6 +1102,8 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             # path makes the same call inside the lane coordinator.
             laser.work_list.extend(survivors)
             strategy.degraded_rounds += 1
+            _cat.DEGRADED_ROUNDS_TOTAL.inc()
+            obs.TRACER.mark("degraded_round", pid=_pid, reason="breaker_open")
             continue
         to_pack = survivors[:seed_cap]
         overflow = survivors[seed_cap:]
@@ -1137,19 +1155,22 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 prune_revert=prune_revert,
             )
             packed_states = []
-            for state in to_pack:
-                try:
-                    bridge.stage(state)
-                    packed_states.append(state)
-                except PackError as e:
-                    log.debug("State stays on host path: %s", e)
-                    laser.work_list.append(state)
-                except Exception as e:  # pragma: no cover - pack bugs degrade
-                    # an unexpected staging failure must not kill the whole
-                    # analysis: the state is untouched (stage wipes the lane
-                    # on failure), so the host path continues it exactly
-                    log.warning("pack failed unexpectedly (%s); host continues", e)
-                    laser.work_list.append(state)
+            with obs.phase("pack", pid=_pid, states=len(to_pack)):
+                for state in to_pack:
+                    try:
+                        bridge.stage(state)
+                        packed_states.append(state)
+                    except PackError as e:
+                        log.debug("State stays on host path: %s", e)
+                        laser.work_list.append(state)
+                    except Exception as e:  # pragma: no cover - pack bugs degrade
+                        # an unexpected staging failure must not kill the whole
+                        # analysis: the state is untouched (stage wipes the lane
+                        # on failure), so the host path continues it exactly
+                        log.warning(
+                            "pack failed unexpectedly (%s); host continues", e
+                        )
+                        laser.work_list.append(state)
             if not packed_states:
                 continue
 
@@ -1158,6 +1179,10 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 # staged states are untouched host-side, continue them
                 laser.work_list.extend(packed_states)
                 strategy.degraded_rounds += 1
+                _cat.DEGRADED_ROUNDS_TOTAL.inc()
+                obs.TRACER.mark(
+                    "degraded_round", pid=_pid, reason="breaker_claimed"
+                )
                 continue
             try:
                 # guarded round: retries with backoff inside (counted on
@@ -1178,6 +1203,11 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 # 2): the next round asks the device for less.
                 log.warning("device round degraded to host path: %s", e)
                 strategy.degraded_rounds += 1
+                _cat.DEGRADED_ROUNDS_TOTAL.inc()
+                obs.TRACER.mark(
+                    "degraded_round", pid=_pid, reason="round_failed",
+                    seam=e.seam,
+                )
                 laser.work_list.extend(packed_states)
                 if e.oom:
                     seed_cap = max(1, seed_cap // 2)
@@ -1197,65 +1227,68 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             if counts:
                 laser.iprof.record_device_round(counts, device_wall)
         strategy.device_rounds += 1
+        _cat.DEVICE_ROUNDS_TOTAL.inc()
         # harvest split: in a shared round only the lanes stamped with
         # THIS job's id feed its counters/coverage — other tenants'
         # lanes (alive or dead) belong to their own accounting
         own_alive = np.asarray(out.alive)
         if job_mask is None:
-            strategy.device_steps_retired += int(np.asarray(out.steps).sum())
+            _steps = int(np.asarray(out.steps).sum())
             strategy.ss_drains += bridge.ss_drain_count
         else:
             own_alive = own_alive & job_mask
-            strategy.device_steps_retired += int(
-                np.asarray(out.steps)[job_mask].sum()
-            )
+            _steps = int(np.asarray(out.steps)[job_mask].sum())
             strategy.ss_drains += bridge.ss_drains_by_job.get(
                 job_ctx.job_id, 0
             )
+        strategy.device_steps_retired += _steps
+        _cat.DEVICE_STEPS_TOTAL.inc(_steps)
         strategy.static_pruned_lanes += int(
             np.asarray(out.static_pruned)[own_alive].sum()
         )
 
         # measurement parity: instructions retired on device feed the same
         # coverage accounting the host's execute_state hook does
-        if laser._device_coverage_hooks:
-            visited = np.asarray(out.visited)
-            code_ids = np.asarray(out.code_id)
+        with obs.phase("harvest", pid=_pid):
+            if laser._device_coverage_hooks:
+                visited = np.asarray(out.visited)
+                code_ids = np.asarray(out.code_id)
+                for code_id, code_bytes in enumerate(bridge.codes):
+                    lanes_mask = own_alive & (code_ids == code_id)
+                    if not lanes_mask.any():
+                        continue
+                    offsets = np.nonzero(visited[lanes_mask].any(axis=0))[0]
+                    if offsets.size == 0:
+                        continue
+                    for hook in laser._device_coverage_hooks:
+                        hook(code_bytes.hex(), offsets.tolist())
+
+            # device-side SWC candidate masks: join the static pass's
+            # per-pc swc_mask plane (lifted into CodeBank.swc_mask)
+            # against the pcs device lanes of THIS job actually visited.
+            # Candidates only — the host detection modules remain the
+            # authoritative confirm; this feeds bench/service counters,
+            # never a report.
+            swc_visited = np.asarray(out.visited)
+            swc_code_ids = np.asarray(out.code_id)
             for code_id, code_bytes in enumerate(bridge.codes):
-                lanes_mask = own_alive & (code_ids == code_id)
+                lanes_mask = own_alive & (swc_code_ids == code_id)
                 if not lanes_mask.any():
                     continue
-                offsets = np.nonzero(visited[lanes_mask].any(axis=0))[0]
-                if offsets.size == 0:
+                try:
+                    mask = static_pass.analyze(code_bytes).swc_mask
+                except Exception as e:  # pragma: no cover - analysis degrade
+                    log.debug("swc harvest: static pass failed: %s", e)
                     continue
-                for hook in laser._device_coverage_hooks:
-                    hook(code_bytes.hex(), offsets.tolist())
-
-        # device-side SWC candidate masks: join the static pass's per-pc
-        # swc_mask plane (lifted into CodeBank.swc_mask) against the pcs
-        # device lanes of THIS job actually visited. Candidates only —
-        # the host detection modules remain the authoritative confirm;
-        # this feeds bench/service counters, never a report.
-        swc_visited = np.asarray(out.visited)
-        swc_code_ids = np.asarray(out.code_id)
-        for code_id, code_bytes in enumerate(bridge.codes):
-            lanes_mask = own_alive & (swc_code_ids == code_id)
-            if not lanes_mask.any():
-                continue
-            try:
-                mask = static_pass.analyze(code_bytes).swc_mask
-            except Exception as e:  # pragma: no cover - analysis degrade
-                log.debug("swc harvest: static pass failed: %s", e)
-                continue
-            width = min(len(mask), swc_visited.shape[1])
-            union = swc_visited[lanes_mask][:, :width].any(axis=0)
-            hit = mask[:width][union]
-            if hit.size == 0:
-                continue
-            for swc, bit in static_pass.SWC_MASK_BITS.items():
-                strategy.swc_candidate_sites[swc] += int(
-                    np.count_nonzero(hit & bit)
-                )
+                width = min(len(mask), swc_visited.shape[1])
+                union = swc_visited[lanes_mask][:, :width].any(axis=0)
+                hit = mask[:width][union]
+                if hit.size == 0:
+                    continue
+                for swc, bit in static_pass.SWC_MASK_BITS.items():
+                    strategy.swc_candidate_sites[swc] += int(
+                        np.count_nonzero(hit & bit)
+                    )
 
         status = np.asarray(out.status)
         resumed_states = []
@@ -1267,32 +1300,36 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
 
         _pi.LAZY_SCREEN = True
         try:
-            for lane in range(own_alive.shape[0]):
-                if not own_alive[lane]:
-                    continue
-                if status[lane] == RUNNING:
-                    # step budget exhausted mid-flight: unpack and
-                    # continue on whatever path the next iteration picks
-                    pass
-                try:
-                    resumed = bridge.unpack_lane(out, lane)
-                except PluginSkipState:
-                    # block-entry replay pruned the state (dependency
-                    # pruner: re-entering cannot observe new writes)
-                    log.debug("lane %d pruned at lifted block entry", lane)
-                    continue
-                except Exception as e:  # pragma: no cover - lift bugs
-                    log.warning("unpack failed for lane %d: %s", lane, e)
-                    continue
-                resumed_states.append(resumed)
+            with obs.phase("lift", pid=_pid):
+                for lane in range(own_alive.shape[0]):
+                    if not own_alive[lane]:
+                        continue
+                    if status[lane] == RUNNING:
+                        # step budget exhausted mid-flight: unpack and
+                        # continue on whatever path the next iteration
+                        # picks
+                        pass
+                    try:
+                        resumed = bridge.unpack_lane(out, lane)
+                    except PluginSkipState:
+                        # block-entry replay pruned the state (dependency
+                        # pruner: re-entering cannot observe new writes)
+                        log.debug("lane %d pruned at lifted block entry", lane)
+                        continue
+                    except Exception as e:  # pragma: no cover - lift bugs
+                        log.warning("unpack failed for lane %d: %s", lane, e)
+                        continue
+                    resumed_states.append(resumed)
         finally:
             _pi.LAZY_SCREEN = False
-        _triage_lazy_screens(resumed_states)
-        laser.work_list.extend(
-            _apply_loop_bound(laser, filter_feasible(resumed_states))
-        )
+        with obs.phase("triage", pid=_pid):
+            _triage_lazy_screens(resumed_states)
+        with obs.phase("solve", pid=_pid):
+            feasible = filter_feasible(resumed_states)
+        laser.work_list.extend(_apply_loop_bound(laser, feasible))
         # device-born forks add to the explored-state count
         laser.total_states += max(0, int(own_alive.sum()) - len(packed_states))
+    obs.TRACER.end_cut("round", pid=_pid)
     if strategy.device_rounds == 0 and not device_ready(cfg, want_stats):
         if _warmup_attempted(cfg, want_stats):
             log.info(

@@ -261,24 +261,33 @@ class DeviceBridge:
         Re-runnable: the staged numpy batch is kept, so a retried round
         (robustness/retry.py) re-enters here and re-uploads the same
         lanes after a transfer fault."""
+        from mythril_tpu import obs
         from mythril_tpu.laser.tpu import transfer
         from mythril_tpu.robustness import faults
 
         faults.fire(faults.TRANSFER_UP, context="bridge.finish")
         if self._np_batch is None or self._n_staged == 0:
             raise PackError("nothing staged")
-        cb = make_code_bank(
-            self.codes,
-            self.cfg.code_len,
-            host_ops=self.host_ops,
-            freeze_errors=self.freeze_errors,
-            record_storage_events=bool(
-                self.tape_replayers.get("SSTORE")
-                or self.tape_replayers.get("SLOAD")
-            ),
-            prune_revert=self.prune_revert,
-        )
-        st = transfer.batch_to_device(self._np_batch, self.cfg)
+        # child spans on the transfer_up row: bank build vs. the actual
+        # host->device upload attribute the seam separately in a trace
+        with obs.TRACER.span(
+            "codebank", tid="transfer_up", n_codes=len(self.codes)
+        ):
+            cb = make_code_bank(
+                self.codes,
+                self.cfg.code_len,
+                host_ops=self.host_ops,
+                freeze_errors=self.freeze_errors,
+                record_storage_events=bool(
+                    self.tape_replayers.get("SSTORE")
+                    or self.tape_replayers.get("SLOAD")
+                ),
+                prune_revert=self.prune_revert,
+            )
+        with obs.TRACER.span(
+            "upload", tid="transfer_up", lanes=self._n_staged
+        ):
+            st = transfer.batch_to_device(self._np_batch, self.cfg)
         return cb, st
 
     def pack(self, states: List[GlobalState]) -> Tuple[CodeBank, StateBatch]:

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mythril_tpu.laser.tpu import symtape, words
+from mythril_tpu import obs
 from mythril_tpu.laser.tpu.batch import (
     ERROR,
     JD_RING,
@@ -1309,7 +1310,10 @@ def _run_impl(
 
 def run(cb: CodeBank, env: Env, st: StateBatch, max_steps: int = 4096):
     """Advance the batch until every lane halts/traps or max_steps."""
-    out, _hist = _run_impl(cb, env, st, max_steps=max_steps, with_stats=False)
+    with obs.TRACER.span("device_slice", tid="device", steps=max_steps):
+        out, _hist = _run_impl(
+            cb, env, st, max_steps=max_steps, with_stats=False
+        )
     return out
 
 
@@ -1317,4 +1321,5 @@ def run_with_stats(
     cb: CodeBank, env: Env, st: StateBatch, max_steps: int = 4096
 ):
     """:func:`run` plus the retired-opcode histogram (see _run_impl)."""
-    return _run_impl(cb, env, st, max_steps=max_steps, with_stats=True)
+    with obs.TRACER.span("device_slice", tid="device", steps=max_steps):
+        return _run_impl(cb, env, st, max_steps=max_steps, with_stats=True)

@@ -55,6 +55,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _cat
 from mythril_tpu.robustness import faults
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.solver import pysat
@@ -694,6 +696,7 @@ class SolverCache:
 
         t0 = time.monotonic()
         n = len(sets)
+        _span = obs.TRACER.begin("decide_batch", tid="solve", n=n)
         self._count("queries", n)
         verdicts: List[Optional[bool]] = [None] * n
         keys: List[Optional[frozenset]] = [None] * n
@@ -735,10 +738,12 @@ class SolverCache:
             if hints is not None:
                 warm = [self.model_hint(hints[i]) for i in pending]
             dev_models: List[Optional[dict]] = [None] * len(sub)
+            _cat.SOLVER_BATCHES_TOTAL.inc()
             try:
-                out = solver_jax.feasibility_batch(
-                    sub, flips=flips, models=warm, return_models=True
-                )
+                with obs.TRACER.span("solver_batch", tid="solve", n=len(sub)):
+                    out = solver_jax.feasibility_batch(
+                        sub, flips=flips, models=warm, return_models=True
+                    )
             except TypeError:
                 # narrower legacy signature (test doubles)
                 try:
@@ -824,6 +829,7 @@ class SolverCache:
                             cancel_event=cancel_event,
                         )
         self._add_time(time.monotonic() - t0)
+        obs.TRACER.end(_span)
         return verdicts
 
     @staticmethod

@@ -36,6 +36,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from mythril_tpu import obs
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.solver import pysat
 from mythril_tpu.smt.solver.bitblast import Blaster, BlastError
@@ -687,9 +688,13 @@ def check_batch(
     max_clauses = min(max_clauses, MAX_CLAUSES)
     live_idx = []
     live_instances = []
-    for i, inst in enumerate(
-        compile_cnf_batch(constraint_sets, max_vars, max_clauses)
-    ):
+    # bitblast/CNF compile cost attributed separately from the kernel
+    # dispatch (obs: the two dominate different workloads)
+    with obs.TRACER.span("cnf_compile", tid="solve", n=n):
+        compiled = list(
+            compile_cnf_batch(constraint_sets, max_vars, max_clauses)
+        )
+    for i, inst in enumerate(compiled):
         if inst is None:
             continue
         if inst.trivial is not None:

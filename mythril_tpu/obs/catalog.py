@@ -1,0 +1,199 @@
+"""The metric catalog: every registry metric name lives HERE.
+
+The ``metric_names`` lint rule (scripts/lint.py) enforces two
+invariants over the whole tree:
+
+1. instruments are constructed only in this module — call sites import
+   the instrument objects below instead of minting name strings;
+2. names are snake_case with a unit suffix: ``_s`` (seconds),
+   ``_bytes``, or ``_total`` (dimensionless count/state).
+
+Rates and ratios (warm-cache rate, solver hit rate) are *not* stored —
+``myth top`` and dashboards derive them from the counters, so the
+catalog stays restatable and the suffix rule stays honest.
+
+Pull collectors for the pre-existing stats surfaces (solver cache,
+circuit breaker, scheduler/coordinator/journal/result-cache) are also
+defined here so their exposition names stay in the one catalog module;
+the owning modules keep their ``stats()`` dicts as thin views of the
+same state.
+"""
+
+from mythril_tpu.obs import metrics as _m
+from mythril_tpu.obs import trace as _trace
+
+REGISTRY = _m.REGISTRY
+
+# -- round loop (laser/tpu/backend.py, service/lanes.py) ---------------
+
+# one observation per phase occurrence; the phase label matches the
+# tracer's thread-row taxonomy (docs/OBSERVABILITY.md)
+ROUND_PHASE_S = REGISTRY.histogram(
+    "myth_round_phase_s",
+    "wall time of one round-loop phase occurrence",
+    labelnames=("phase",),
+)
+DEVICE_ROUNDS_TOTAL = REGISTRY.counter(
+    "myth_device_rounds_total", "device rounds executed"
+)
+DEVICE_STEPS_TOTAL = REGISTRY.counter(
+    "myth_device_steps_total", "device lane-steps retired"
+)
+DEVICE_SLICES_TOTAL = REGISTRY.counter(
+    "myth_device_slices_total", "jitted step-kernel slices dispatched"
+)
+SOLVER_BATCHES_TOTAL = REGISTRY.counter(
+    "myth_solver_batches_total",
+    "device feasibility kernel batches dispatched",
+)
+
+# -- robustness (robustness/retry.py, faults.py, checkpoint.py) --------
+
+DEVICE_RETRIES_TOTAL = REGISTRY.counter(
+    "myth_device_retries_total", "device round attempts retried"
+)
+DEGRADED_ROUNDS_TOTAL = REGISTRY.counter(
+    "myth_degraded_rounds_total",
+    "rounds completed on the host degrade path",
+)
+FAULTS_INJECTED_TOTAL = REGISTRY.counter(
+    "myth_faults_injected_total",
+    "planned faults fired by the injection harness",
+    labelnames=("seam",),
+)
+CHECKPOINTS_TOTAL = REGISTRY.counter(
+    "myth_checkpoints_total", "frontier checkpoints journaled"
+)
+CHECKPOINT_OVERHEAD_S = REGISTRY.counter(
+    "myth_checkpoint_overhead_s", "cumulative checkpoint serialization time"
+)
+
+# -- static pass + hook gating (analysis/) -----------------------------
+
+STATIC_PASS_S = REGISTRY.counter(
+    "myth_static_pass_s", "cumulative static pre-analysis wall time"
+)
+TAINT_PASS_S = REGISTRY.counter(
+    "myth_taint_pass_s", "cumulative taint/dataflow stage wall time"
+)
+STATIC_CONTRACTS_TOTAL = REGISTRY.counter(
+    "myth_static_contracts_total", "contracts statically analyzed"
+)
+STATIC_CACHE_HITS_TOTAL = REGISTRY.counter(
+    "myth_static_cache_hits_total", "static-analysis memo hits"
+)
+HOOK_DISPATCHES_TOTAL = REGISTRY.counter(
+    "myth_hook_dispatches_total", "detection-module hook dispatches"
+)
+HOOK_SKIPPED_TOTAL = REGISTRY.counter(
+    "myth_hook_skipped_total", "hook dispatches skipped by the static gate"
+)
+MODULE_EXEC_S = REGISTRY.counter(
+    "myth_module_exec_s",
+    "cumulative POST detection-module execute() wall time",
+    labelnames=("module",),
+)
+
+# -- obs self-accounting ----------------------------------------------
+
+TRACE_DROPPED_TOTAL = REGISTRY.counter(
+    "myth_trace_dropped_total", "trace events dropped by the ring buffer"
+)
+
+
+# -- pull collectors for the pre-existing stats surfaces ---------------
+
+def _solver_samples():
+    from mythril_tpu.laser.tpu import solver_cache
+
+    snap = solver_cache.GLOBAL.snapshot()
+    return [
+        ("myth_solver_queries_total", (), snap["queries"]),
+        ("myth_solver_hits_total", (("kind", "exact"),), snap["hits_exact"]),
+        ("myth_solver_hits_total", (("kind", "alpha"),), snap["hits_alpha"]),
+        (
+            "myth_solver_hits_total",
+            (("kind", "subsume"),),
+            snap["hits_subsume"],
+        ),
+        ("myth_solver_device_decided_total", (), snap["device_decided"]),
+        ("myth_solver_host_decided_total", (), snap["host_decided"]),
+        ("myth_solver_unknown_total", (), snap["unknown"]),
+        (
+            "myth_solver_async_total",
+            (("state", "submitted"),),
+            snap["async_submitted"],
+        ),
+        (
+            "myth_solver_async_total",
+            (("state", "completed"),),
+            snap["async_completed"],
+        ),
+        (
+            "myth_solver_async_total",
+            (("state", "dropped"),),
+            snap["async_dropped"],
+        ),
+        (
+            "myth_solver_static_unsat_seeds_total",
+            (),
+            snap["static_unsat_seeds"],
+        ),
+        ("myth_solver_pending_total", (), snap["pending"]),
+        ("myth_solver_time_s", (), snap["time_s"]),
+    ]
+
+
+def _robustness_samples():
+    from mythril_tpu.robustness import retry
+
+    return [
+        ("myth_breaker_trips_total", (), retry.BREAKER.trips),
+        ("myth_breaker_open_total", (), 1.0 if retry.BREAKER.open else 0.0),
+        ("myth_trace_dropped_total", (), float(_trace.TRACER.dropped)),
+    ]
+
+
+def make_service_collector(service):
+    """Sample fn for one AnalysisService (scheduler/lanes/journal/cache).
+
+    Registered under the keyed slot ``"service"`` so a fresh service
+    instance (tests, restarts) replaces the previous collector instead
+    of double-emitting."""
+
+    def _service_samples():
+        st = service.stats()
+        cache = st["cache"]
+        return [
+            ("myth_jobs_total", (("state", "submitted"),), st["jobs_submitted"]),
+            ("myth_jobs_total", (("state", "done"),), st["jobs_done"]),
+            ("myth_jobs_total", (("state", "failed"),), st["jobs_failed"]),
+            (
+                "myth_jobs_total",
+                (("state", "cancelled"),),
+                st["jobs_cancelled"],
+            ),
+            ("myth_jobs_total", (("state", "retried"),), st["jobs_retried"]),
+            ("myth_queue_depth_total", (), st["queued"]),
+            ("myth_rounds_total", (), st["rounds"]),
+            ("myth_shared_rounds_total", (), st["shared_rounds"]),
+            ("myth_resident_jobs_peak_total", (), st["max_resident_jobs"]),
+            ("myth_result_cache_entries_total", (), cache["entries"]),
+            ("myth_result_cache_hits_total", (), cache["hits"]),
+            ("myth_result_cache_misses_total", (), cache["misses"]),
+            ("myth_quarantined_jobs_total", (), st["quarantined_jobs"]),
+        ]
+
+    return _service_samples
+
+
+def register_default_collectors() -> None:
+    REGISTRY.register_collector("solver", _solver_samples)
+    REGISTRY.register_collector("robustness", _robustness_samples)
+
+
+def register_service(service) -> None:
+    REGISTRY.register_collector("service", make_service_collector(service))
+
+
+register_default_collectors()

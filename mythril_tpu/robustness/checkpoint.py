@@ -23,6 +23,9 @@ import threading
 import time
 from typing import Dict, Optional
 
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _cat
+
 log = logging.getLogger(__name__)
 
 ENV_EVERY = "MYTHRIL_TPU_CKPT_EVERY"
@@ -113,10 +116,16 @@ class CheckpointJournal:
                 log.warning("checkpoint snapshot failed for job %s "
                             "(round %d): %s", job_id, done, e)
                 return
+            dt = time.time() - t0
             with self._lock:
                 self._latest[job_id] = ckpt
                 self.snapshots += 1
-                self.overhead_s += time.time() - t0
+                self.overhead_s += dt
+            _cat.CHECKPOINTS_TOTAL.inc()
+            _cat.CHECKPOINT_OVERHEAD_S.inc(dt)
+            obs.TRACER.mark(
+                "checkpoint", job=job_id, round=done, states=ckpt.n_states,
+            )
             log.debug("journaled %s", ckpt)
 
         laser.register_laser_hooks("stop_sym_trans", journal_hook)

@@ -295,6 +295,14 @@ def fire(seam: str, context: Optional[str] = None) -> None:
         return
     exc = plan.maybe(seam, context)
     if exc is not None:
+        from mythril_tpu import obs
+        from mythril_tpu.obs import catalog
+
+        catalog.FAULTS_INJECTED_TOTAL.inc(1.0, seam)
+        obs.TRACER.mark(
+            "fault_injected", seam=seam, kind=type(exc).__name__,
+            context=context,
+        )
         log.warning("injecting %s at seam %r (context=%r)",
                     type(exc).__name__, seam, context)
         raise exc

@@ -27,6 +27,8 @@ import logging
 import threading
 import time
 
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _cat
 from mythril_tpu.robustness import faults
 
 log = logging.getLogger(__name__)
@@ -95,6 +97,7 @@ class CircuitBreaker:
     def record_success(self) -> None:
         with self._lock:
             if self._opened_at is not None:
+                obs.TRACER.mark("breaker_close")
                 log.warning("device circuit breaker CLOSED (trial round ok)")
             self._failures = 0
             self._opened_at = None
@@ -109,6 +112,7 @@ class CircuitBreaker:
             if self._failures >= self.threshold:
                 self._opened_at = time.monotonic()
                 self.trips += 1
+                obs.TRACER.mark("breaker_open", failures=self._failures)
                 log.warning(
                     "device circuit breaker OPEN after %d consecutive "
                     "round failures: continuing HOST-ONLY (retry in %.0fs)",
@@ -169,16 +173,21 @@ def run_round_guarded(bridge, cfg, *, want_stats=False, deadline=None,
             delay *= 2
             if counters is not None:
                 counters.device_retries += 1
+            _cat.DEVICE_RETRIES_TOTAL.inc()
+            obs.TRACER.mark("device_retry", attempt=attempt)
         try:
             faults.fire(faults.DEVICE_ROUND)
-            cb, st = bridge.finish()
+            with obs.phase("transfer_up"):
+                cb, st = bridge.finish()
             t0 = time.time()
-            out, op_hist = backend._run_device(
-                cb, st, cfg, want_stats=want_stats,
-                deadline=deadline, bridge=bridge,
-            )
+            with obs.phase("device_round"):
+                out, op_hist = backend._run_device(
+                    cb, st, cfg, want_stats=want_stats,
+                    deadline=deadline, bridge=bridge,
+                )
             device_wall = time.time() - t0
-            out = transfer.batch_to_host(out)
+            with obs.phase("transfer_down"):
+                out = transfer.batch_to_host(out)
             BREAKER.record_success()
             return out, op_hist, device_wall
         except Exception as e:

@@ -46,6 +46,7 @@ def handle_request(service: AnalysisService, request: Dict) -> Dict:
                 modules=request.get("modules"),
                 name=str(request.get("name", "contract")),
                 max_depth=int(request.get("max_depth", 128)),
+                trace=bool(request.get("trace", False)),
             )
             return {"ok": True, "job_id": job_id}
         if op == "status":
@@ -63,6 +64,13 @@ def handle_request(service: AnalysisService, request: Dict) -> Dict:
             return {"ok": True, "cancelled": service.cancel(int(request["job_id"]))}
         if op == "stats":
             return {"ok": True, **service.stats()}
+        if op == "metrics":
+            # Prometheus exposition text: one scrape covers the solver
+            # cache, scheduler, robustness ladder, and static-pass
+            # counters (all registered in obs/catalog.py)
+            from mythril_tpu.obs import REGISTRY
+
+            return {"ok": True, "metrics": REGISTRY.render_prometheus()}
         if op == "health":
             # one-glance liveness for operators/load balancers: breaker
             # posture, degraded-round pressure, and quarantine count

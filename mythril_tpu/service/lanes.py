@@ -45,6 +45,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _cat
+
 log = logging.getLogger(__name__)
 
 # how long the round leader waits for other active jobs to reach their
@@ -249,6 +252,8 @@ class LaneCoordinator:
                 # participant degrades on the spot — all states come
                 # back via ``failed`` and continue on the host path.
                 self.degraded_rounds += 1
+                _cat.DEGRADED_ROUNDS_TOTAL.inc()
+                obs.TRACER.mark("degraded_round", reason="breaker_open")
                 for req in batch:
                     req.result = RoundResult(
                         None, None, [], list(req.states), 0.0, degraded=True
@@ -283,23 +288,25 @@ class LaneCoordinator:
                     prune_revert=prune_revert,
                 )
                 bridge.ss_drains_by_job = self.ss_drains_by_job = {}
-                for req in batch:
-                    bridge.job_id = req.job_id
-                    for state in req.states:
-                        if bridge._n_staged >= self.cfg.lanes:
-                            req.failed.append(state)
-                            continue
-                        try:
-                            bridge.stage(state)
-                            req.packed.append(state)
-                        except PackError as e:
-                            log.debug("state stays on host path: %s", e)
-                            req.failed.append(state)
-                        except Exception as e:  # pragma: no cover
-                            log.warning(
-                                "pack failed unexpectedly (%s); host continues", e
-                            )
-                            req.failed.append(state)
+                with obs.phase("pack", jobs=len(batch)):
+                    for req in batch:
+                        bridge.job_id = req.job_id
+                        for state in req.states:
+                            if bridge._n_staged >= self.cfg.lanes:
+                                req.failed.append(state)
+                                continue
+                            try:
+                                bridge.stage(state)
+                                req.packed.append(state)
+                            except PackError as e:
+                                log.debug("state stays on host path: %s", e)
+                                req.failed.append(state)
+                            except Exception as e:  # pragma: no cover
+                                log.warning(
+                                    "pack failed unexpectedly (%s); "
+                                    "host continues", e
+                                )
+                                req.failed.append(state)
                 if not any(req.packed for req in batch):
                     for req in batch:
                         req.result = RoundResult(
@@ -327,6 +334,10 @@ class LaneCoordinator:
                 # put-back as a pack failure — nothing is dropped)
                 log.warning("shared device round degraded to host: %s", e)
                 self.degraded_rounds += 1
+                _cat.DEGRADED_ROUNDS_TOTAL.inc()
+                obs.TRACER.mark(
+                    "degraded_round", reason="round_failed", seam=e.seam,
+                )
                 self.device_retries += counters.device_retries
                 for req in batch:
                     req.result = RoundResult(

@@ -44,6 +44,8 @@ from collections import deque
 from enum import Enum
 from typing import Dict, List, Optional
 
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _obs_catalog
 from mythril_tpu.robustness import faults
 from mythril_tpu.robustness.checkpoint import CheckpointJournal
 from mythril_tpu.service.cache import QUARANTINE_AFTER, ResultCache, cache_key
@@ -118,6 +120,10 @@ class AnalysisJob:
         self.retried = False
         self.device_retries = 0
         self.degraded_rounds = 0
+        # per-job span timeline (api submit with trace=True): the
+        # tracer cursor at attempt start bounds this job's event slice
+        self.trace = False
+        self.trace_cursor = 0
         self.cancel_event = threading.Event()
         self.done_event = threading.Event()
         self._finish_lock = threading.Lock()
@@ -206,11 +212,18 @@ class AnalysisService:
         self._jobs: Dict[int, AnalysisJob] = {}
         self._ids = itertools.count(1)  # 0 marks a free lane (batch.py)
         self._shutdown = False
+        # service counters: every mutation goes through _count() (or
+        # happens while already holding _queue_cv's lock) so concurrent
+        # worker finishes cannot lose increments (ISSUE 9 satellite);
+        # stats() reads them under the same lock
         self.jobs_submitted = 0
         self.jobs_done = 0
         self.jobs_failed = 0
         self.jobs_cancelled = 0
         self.jobs_retried = 0
+        # Prometheus exposition: this instance's samples replace any
+        # prior service's in the shared registry (keyed slot)
+        _obs_catalog.register_service(self)
         self._workers = [
             threading.Thread(
                 target=self._worker, name="analysis-worker-%d" % i, daemon=True
@@ -237,6 +250,7 @@ class AnalysisService:
         modules: Optional[List[str]] = None,
         name: str = "contract",
         max_depth: int = 128,
+        trace: bool = False,
     ) -> int:
         """Admit a job; returns its id. Raises AdmissionError on
         malformed input, QueueFullError under backpressure."""
@@ -262,8 +276,11 @@ class AnalysisService:
             next(self._ids), name, runtime_hex, creation_hex,
             tx_count, timeout, modules, max_depth,
         )
+        if trace:
+            job.trace = True
+            obs.TRACER.enable()
         self._jobs[job.id] = job
-        self.jobs_submitted += 1
+        self._count("jobs_submitted")
 
         entry = self.cache.get(job.key, tx_count, modules, timeout)
         if entry is not None:
@@ -276,7 +293,7 @@ class AnalysisService:
                 "cold_wall_s": entry.cold_wall_s,
             }
             job.finish(JobState.DONE)
-            self.jobs_done += 1
+            self._count("jobs_done")
             return job.id
 
         with self._queue_cv:
@@ -320,13 +337,17 @@ class AnalysisService:
         from mythril_tpu.robustness import retry
 
         ckpt = self.journal.stats()
+        with self._queue_cv:
+            counters = {
+                "jobs_submitted": self.jobs_submitted,
+                "jobs_done": self.jobs_done,
+                "jobs_failed": self.jobs_failed,
+                "jobs_cancelled": self.jobs_cancelled,
+                "jobs_retried": self.jobs_retried,
+                "queued": len(self._queue),
+            }
         return {
-            "jobs_submitted": self.jobs_submitted,
-            "jobs_done": self.jobs_done,
-            "jobs_failed": self.jobs_failed,
-            "jobs_cancelled": self.jobs_cancelled,
-            "jobs_retried": self.jobs_retried,
-            "queued": len(self._queue),
+            **counters,
             "rounds": self.coordinator.rounds,
             "shared_rounds": self.coordinator.shared_rounds,
             "max_resident_jobs": self.coordinator.max_resident_jobs,
@@ -354,7 +375,7 @@ class AnalysisService:
             self._queue_cv.notify_all()
         for job in drained:
             if job.finish(JobState.CANCELLED):
-                self.jobs_cancelled += 1
+                self._count("jobs_cancelled")
         if not wait:
             return
         deadline = (
@@ -373,7 +394,7 @@ class AnalysisService:
                 job.cancel_event.set()
                 job.error = "service shutdown before job completed"
                 if job.finish(JobState.FAILED):
-                    self.jobs_failed += 1
+                    self._count("jobs_failed")
 
     # -------------------------------------------------------------- workers
 
@@ -382,6 +403,15 @@ class AnalysisService:
         if job is None:
             raise KeyError("unknown job id %r" % job_id)
         return job
+
+    def _count(self, counter: str, delta: int = 1) -> None:
+        """Adjust a jobs_* counter under the scheduler lock. A bare
+        ``+= 1`` is a read-modify-write: two workers finalizing
+        concurrently can lose one. Callers that already hold
+        ``_queue_cv`` mutate directly instead (the Condition lock is
+        not reentrant)."""
+        with self._queue_cv:
+            setattr(self, counter, getattr(self, counter) + delta)
 
     def _next_job(self) -> Optional[AnalysisJob]:
         with self._queue_cv:
@@ -411,7 +441,7 @@ class AnalysisService:
                 log.exception("worker crashed on job %d: %s", job.id, e)
                 job.error = "internal worker failure: %s" % e
                 if job.finish(JobState.FAILED):
-                    self.jobs_failed += 1
+                    self._count("jobs_failed")
 
     def _run_job(self, job: AnalysisJob) -> None:
         """One job, at most two attempts.
@@ -425,6 +455,7 @@ class AnalysisService:
         leave no strikes behind (_finalize -> cache.record_success)."""
         job.state = JobState.RUNNING
         job.started_at = time.time()
+        job.trace_cursor = obs.TRACER.cursor()
         outcome = self._run_attempt(job, attempt=0)
         if (
             outcome["crashed"]
@@ -432,6 +463,10 @@ class AnalysisService:
             and not self._shutdown
         ):
             strikes = self.cache.record_crash(job.key, outcome["report"])
+            if strikes >= QUARANTINE_AFTER:
+                obs.TRACER.mark(
+                    "quarantine", pid=job.id, job=job.name, strikes=strikes,
+                )
             if strikes < QUARANTINE_AFTER:
                 ckpt = self.journal.latest(job.id)
                 log.warning(
@@ -440,10 +475,17 @@ class AnalysisService:
                     ckpt if ckpt is not None else "scratch",
                 )
                 job.retried = True
-                self.jobs_retried += 1
+                self._count("jobs_retried")
                 outcome = self._run_attempt(job, attempt=1, resume=ckpt)
                 if outcome["crashed"] and not job.cancel_event.is_set():
-                    self.cache.record_crash(job.key, outcome["report"])
+                    strikes = self.cache.record_crash(
+                        job.key, outcome["report"]
+                    )
+                    if strikes >= QUARANTINE_AFTER:
+                        obs.TRACER.mark(
+                            "quarantine", pid=job.id, job=job.name,
+                            strikes=strikes,
+                        )
         self.journal.clear(job.id)
         self._finalize(job, outcome)
 
@@ -557,13 +599,13 @@ class AnalysisService:
         )
         if job.cancel_event.is_set():
             if job.finish(JobState.CANCELLED):
-                self.jobs_cancelled += 1
+                self._count("jobs_cancelled")
             return
         if outcome["error"] is not None:
             job.error = outcome["error"]
             job.error_report = outcome["report"]
             if job.finish(JobState.FAILED):
-                self.jobs_failed += 1
+                self._count("jobs_failed")
             return
 
         self.cache.record_success(job.key)
@@ -582,11 +624,18 @@ class AnalysisService:
             "device_retries": job.device_retries,
             "degraded_rounds": job.degraded_rounds,
         }
+        if job.trace and obs.TRACER.enabled:
+            # per-job span timeline: this job's process row (its own
+            # pid) plus the shared device/solver rows (pid 0) since the
+            # attempt started
+            job.result["trace_events"] = obs.TRACER.chrome_events(
+                since=job.trace_cursor, pids={0, job.id}
+            )
         if not job.finish(JobState.DONE):
             # shutdown failed this job while its worker was finalizing;
             # the shutdown verdict stands and nothing is cached
             return
-        self.jobs_done += 1
+        self._count("jobs_done")
         # export the verdicts this job decided so resubmissions of the
         # same contract (any parameters) start with a warm memo table
         self.cache.put_solver_memo(job.key, solver_cache.GLOBAL.export_memo())

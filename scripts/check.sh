@@ -68,6 +68,15 @@ python -m pytest tests/service/test_cache.py \
     tests/service/test_api.py \
     -q -p no:cacheprovider
 
+echo "== obs fast tests =="
+# metrics registry, tracer, the phase helper, and the service metrics
+# endpoint (stubbed lifecycle) — all host-side, sub-second. The golden
+# trace-schema test runs a real device pipeline and is deselected here;
+# it runs with the full suite.
+python -m pytest tests/obs/ \
+    -q -p no:cacheprovider \
+    -k "not golden and not injected_fault"
+
 echo "== robustness fast tests =="
 # fault harness parsing/determinism, retry ladder + breaker transitions,
 # checkpoint journal, and the scheduler crash-isolation/quarantine unit

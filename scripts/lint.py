@@ -34,6 +34,7 @@ Run via scripts/check.sh. Exit 0 = clean.
 
 import ast
 import builtins
+import re
 import sys
 from pathlib import Path
 
@@ -492,6 +493,65 @@ def seam_exceptions(tree: ast.AST, source: str, rel: str):
     return sorted(set(out))
 
 
+# Metric naming contract (docs/OBSERVABILITY.md): snake_case with an
+# explicit unit suffix — seconds, bytes, or a dimensionless count/state.
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(_s|_bytes|_total)$")
+_METRIC_CATALOG = "mythril_tpu/obs/catalog.py"
+
+
+def metric_names(tree: ast.AST, source: str, rel: str):
+    """(lineno, desc) pairs enforcing the obs metric-name contract:
+    instruments (``REGISTRY.counter/gauge/histogram("name", ...)``) are
+    constructed only in the catalog module, and every name there — the
+    instrument names and the ``myth_*`` exposition names minted by pull
+    collectors — matches _METRIC_NAME_RE. Tests are exempt (they build
+    throwaway registries); noqa exempts a line."""
+    if rel.startswith("tests/") or rel == "mythril_tpu/obs/metrics.py":
+        return []
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("counter", "gauge", "histogram")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        if _noqa(lines, node.lineno):
+            continue
+        name = node.args[0].value
+        if rel != _METRIC_CATALOG:
+            out.append((
+                node.lineno,
+                f"metric '{name}' constructed outside the catalog "
+                f"module ({_METRIC_CATALOG})",
+            ))
+        elif not _METRIC_NAME_RE.match(name):
+            out.append((
+                node.lineno,
+                f"metric name '{name}' must be snake_case with a unit "
+                "suffix (_s/_bytes/_total)",
+            ))
+    if rel == _METRIC_CATALOG:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith("myth_")
+                and not _METRIC_NAME_RE.match(node.value)
+                and not _noqa(lines, node.lineno)
+            ):
+                out.append((
+                    node.lineno,
+                    f"metric name '{node.value}' must be snake_case "
+                    "with a unit suffix (_s/_bytes/_total)",
+                ))
+    return sorted(set(out))
+
+
 def _swc_registry():
     """(constant name -> id string, set of valid SWC id strings) from
     analysis/swc_data.py (module-level string assignments + the
@@ -644,6 +704,8 @@ def main() -> int:
         for lineno, desc in solver_boundary(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for lineno, desc in seam_exceptions(tree, source, str(rel)):
+            problems.append(f"{rel}:{lineno}: {desc}")
+        for lineno, desc in metric_names(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")

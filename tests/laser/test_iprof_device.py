@@ -63,3 +63,29 @@ def test_record_device_round_accumulates():
     assert iprof.device_counts["MSTORE"] == 1
     assert abs(iprof.device_time - 0.75) < 1e-9
     assert "[ADD" in repr(iprof)
+
+
+def test_repr_merges_device_rows_into_sorted_table():
+    """Regression (ISSUE 9 satellite): device-retired ops used to render
+    in a separate trailing section, so an opcode executed on both tiers
+    showed only its host row in the table. The union table must list
+    device-only ops in sorted position and show BOTH columns for ops
+    that ran on both tiers."""
+    iprof = InstructionProfiler()
+    iprof.record("ADD", 0.0, 0.5)
+    iprof.record("SSTORE", 0.0, 0.25)
+    iprof.record_device_round({"ADD": 4, "MUL": 6}, 1.0)
+    text = repr(iprof)
+    table = [l for l in text.splitlines() if l.startswith("[")]
+    ops = [l.split("]")[0].strip("[ ") for l in table]
+    # sorted union: the device-only MUL row sits between the host rows
+    assert ops == ["ADD", "MUL", "SSTORE"]
+    add_row = table[0]
+    assert "host nr 1" in add_row and "device nr 4" in add_row
+    mul_row = table[1]
+    assert "device nr 6" in mul_row and "host" not in mul_row
+    sstore_row = table[2]
+    assert "host nr 1" in sstore_row and "device" not in sstore_row
+    # header splits the total across tiers; footer summary retained
+    assert "Total: 1.750000 s (host 0.750000 s + device 1.000000 s)" in text
+    assert "Device rounds: 1.000000 s, 10 instructions retired" in text

@@ -1,0 +1,22 @@
+"""Observability tests share the process-global registry and tracer
+(mythril_tpu/obs); reset both around every test so counter values and
+recorded spans never leak between tests."""
+
+import pytest
+
+from mythril_tpu import obs
+from mythril_tpu.obs import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    was_enabled = metrics.enabled()
+    metrics.set_enabled(True)
+    obs.REGISTRY.reset()
+    obs.TRACER.disable()
+    obs.TRACER.clear()
+    yield
+    metrics.set_enabled(was_enabled)
+    obs.REGISTRY.reset()
+    obs.TRACER.disable()
+    obs.TRACER.clear()

@@ -1,0 +1,170 @@
+"""Unit tests for the metrics registry (mythril_tpu/obs/metrics.py):
+instrument semantics, labels, the disabled fast path, pull collectors,
+the unified snapshot, and the Prometheus text exposition."""
+
+import threading
+
+import pytest
+
+from mythril_tpu.obs import catalog, metrics
+from mythril_tpu.obs.metrics import MetricsRegistry
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry()
+
+
+def test_counter_inc_value_and_labels(reg):
+    c = reg.counter("c_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.value() == 3.5
+
+    lc = reg.counter("l_total", "help", labelnames=("kind",))
+    lc.inc(1.0, "a")
+    lc.labels("b").inc(4.0)
+    assert lc.value("a") == 1.0
+    assert lc.value("b") == 4.0
+    with pytest.raises(ValueError):
+        lc.inc()  # missing label value
+
+
+def test_gauge_set_and_max(reg):
+    g = reg.gauge("g_total", "help")
+    g.set(3)
+    g.max(1)
+    assert g.value() == 3.0
+    g.max(7)
+    assert g.value() == 7.0
+    g.set(2)
+    assert g.value() == 2.0
+
+
+def test_histogram_observe_percentile_count(reg):
+    h = reg.histogram("h_s", "help", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 2.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.percentile(0) == 0.05
+    assert h.percentile(100) == 2.0
+    assert h.percentile(50) == 0.5
+    # cumulative buckets: le=0.1 -> 1, le=1.0 -> 3, +Inf -> 4
+    by_le = {
+        dict(labels)["le"]: value
+        for name, labels, value in h.samples()
+        if name == "h_s_bucket"
+    }
+    assert by_le["0.1"] == 1
+    assert by_le["1.0"] == 3
+    assert by_le["+Inf"] == 4
+    sums = {n: v for n, _, v in h.samples() if n in ("h_s_sum", "h_s_count")}
+    assert abs(sums["h_s_sum"] - 3.05) < 1e-9
+    assert sums["h_s_count"] == 4
+
+
+def test_histogram_empty_percentile_is_none(reg):
+    h = reg.histogram("e_s", "help")
+    assert h.percentile(50) is None
+
+
+def test_disabled_mutations_are_noops(reg):
+    c = reg.counter("d_total", "help")
+    g = reg.gauge("dg_total", "help")
+    h = reg.histogram("dh_s", "help")
+    metrics.set_enabled(False)
+    try:
+        c.inc()
+        c.labels().inc()
+        g.set(5)
+        g.max(5)
+        h.observe(1.0)
+    finally:
+        metrics.set_enabled(True)
+    assert c.value() == 0.0
+    assert g.value() == 0.0
+    assert h.count() == 0
+
+
+def test_registration_idempotent_and_kind_checked(reg):
+    a = reg.counter("x_total", "help")
+    b = reg.counter("x_total", "other help")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "help")
+
+
+def test_collector_slots_replace_and_survive_errors(reg):
+    reg.counter("base_total", "help").inc(2)
+    reg.register_collector(
+        "svc", lambda: [("pulled_total", (("k", "v"),), 9.0)]
+    )
+    snap = reg.snapshot()
+    assert snap["base_total"] == 2.0
+    assert snap['pulled_total{k="v"}'] == 9.0
+    # same slot replaces: no duplicate samples from a re-registration
+    reg.register_collector("svc", lambda: [("pulled_total", (), 1.0)])
+    snap = reg.snapshot()
+    assert snap["pulled_total"] == 1.0
+    assert 'pulled_total{k="v"}' not in snap
+
+    def boom():
+        raise RuntimeError("collector died")
+
+    reg.register_collector("bad", boom)
+    # a broken collector is skipped, not fatal
+    assert reg.snapshot()["pulled_total"] == 1.0
+    assert "pulled_total 1" in reg.render_prometheus()
+
+
+def test_reset_zeroes_instruments_only(reg):
+    c = reg.counter("r_total", "help")
+    c.inc(5)
+    reg.register_collector("k", lambda: [("ext_total", (), 3.0)])
+    reg.reset()
+    snap = reg.snapshot()
+    assert c.value() == 0.0
+    assert snap["ext_total"] == 3.0
+
+
+def test_render_prometheus_shape(reg):
+    c = reg.counter("req_total", "requests seen", labelnames=("kind",))
+    c.inc(3, "warm")
+    h = reg.histogram("lat_s", "latency", buckets=(1.0,))
+    h.observe(0.5)
+    text = reg.render_prometheus()
+    assert "# HELP req_total requests seen" in text
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{kind="warm"} 3' in text
+    assert "# TYPE lat_s histogram" in text
+    assert 'lat_s_bucket{le="1.0"} 1' in text
+    assert "lat_s_count 1" in text
+    assert text.endswith("\n")
+
+
+def test_concurrent_increments_lose_nothing(reg):
+    c = reg.counter("mt_total", "help")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000.0
+
+
+def test_catalog_names_resolve_in_global_registry():
+    """The catalog registers on the process registry at import; the
+    service metrics op renders from the same object."""
+    catalog.DEVICE_ROUNDS_TOTAL.inc(2)
+    catalog.ROUND_PHASE_S.observe(0.01, "pack")
+    snap = metrics.REGISTRY.snapshot()
+    assert snap["myth_device_rounds_total"] == 2.0
+    assert snap['myth_round_phase_s_count{phase="pack"}'] == 1
+    # solver + robustness pull collectors are registered by default
+    assert "myth_solver_queries_total" in snap
+    assert "myth_breaker_trips_total" in snap

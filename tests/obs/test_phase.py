@@ -1,0 +1,44 @@
+"""The ``obs.phase`` helper: one context manager feeding BOTH the span
+tracer and the round-phase histogram, each independently switchable."""
+
+from mythril_tpu import obs
+from mythril_tpu.obs import catalog, metrics
+
+
+def test_phase_feeds_tracer_and_histogram():
+    obs.TRACER.enable()
+    with obs.phase("pack", pid=2, states=5):
+        pass
+    (span,) = [
+        e for e in obs.TRACER.chrome_events() if e.get("name") == "pack"
+    ]
+    assert span["pid"] == 2
+    assert catalog.ROUND_PHASE_S.count("pack") == 1
+
+
+def test_phase_metrics_only():
+    with obs.phase("lift"):
+        pass
+    assert obs.TRACER.chrome_events() == []
+    assert catalog.ROUND_PHASE_S.count("lift") == 1
+
+
+def test_phase_tracing_only():
+    metrics.set_enabled(False)
+    obs.TRACER.enable()
+    with obs.phase("harvest"):
+        pass
+    metrics.set_enabled(True)
+    assert catalog.ROUND_PHASE_S.count("harvest") == 0
+    assert any(
+        e.get("name") == "harvest" for e in obs.TRACER.chrome_events()
+    )
+
+
+def test_phase_both_off_is_noop():
+    metrics.set_enabled(False)
+    with obs.phase("solve"):
+        pass
+    metrics.set_enabled(True)
+    assert obs.TRACER.chrome_events() == []
+    assert catalog.ROUND_PHASE_S.count("solve") == 0

@@ -1,0 +1,143 @@
+"""Unit tests for the span tracer (mythril_tpu/obs/trace.py): span /
+mark / cut recording, the disabled fast path, the bounded ring, and the
+Chrome trace-event export shape."""
+
+import json
+
+from mythril_tpu.obs.trace import _NULL_SPAN, Tracer
+
+REQUIRED_KEYS = {"ph", "ts", "dur", "pid", "tid", "name"}
+
+
+def spans(events, name=None):
+    out = [e for e in events if e["ph"] == "X"]
+    if name is not None:
+        out = [e for e in out if e["name"] == name]
+    return out
+
+
+def test_disabled_is_noop():
+    t = Tracer()
+    assert t.span("x") is _NULL_SPAN
+    assert t.begin("x") is None
+    t.end(None)
+    t.mark("x")
+    t.cut("round", "round")
+    t.end_cut("round")
+    assert t.chrome_events() == []
+
+
+def test_span_and_mark_record_events():
+    t = Tracer()
+    t.enable()
+    with t.span("pack", tid="pack", pid=3, states=7):
+        pass
+    t.mark("device_retry", attempt=1)
+    events = t.chrome_events()
+    assert all(REQUIRED_KEYS <= set(e.keys()) for e in events)
+    (pack,) = spans(events, "pack")
+    assert pack["pid"] == 3
+    assert pack["dur"] >= 0
+    assert pack["args"] == {"states": 7}
+    (mark,) = [e for e in events if e["ph"] == "i"]
+    assert mark["name"] == "device_retry"
+    assert mark["s"] == "t"
+    assert mark["dur"] == 0
+
+
+def test_begin_end_token():
+    t = Tracer()
+    t.enable()
+    token = t.begin("solve", tid="solve", n=4)
+    t.end(token)
+    (solve,) = spans(t.chrome_events(), "solve")
+    assert solve["args"] == {"n": 4}
+
+
+def test_cut_closes_previous_and_flushes_at_export():
+    t = Tracer()
+    t.enable()
+    t.cut("round", "round", round=1)
+    t.cut("round", "round", round=2)  # closes round 1
+    # round 2 left open (early return) -> healed by export
+    events = spans(t.chrome_events(), "round")
+    assert [e["args"]["round"] for e in events] == [1, 2]
+    # spans on one track never overlap
+    assert events[0]["ts"] + events[0]["dur"] <= events[1]["ts"] + 0.1
+
+
+def test_end_cut_closes_track():
+    t = Tracer()
+    t.enable()
+    t.cut("round", "round", round=1)
+    t.end_cut("round")
+    assert len(spans(t.chrome_events(), "round")) == 1
+    # nothing left open: a second export adds no new round span
+    assert len(spans(t.chrome_events(), "round")) == 1
+
+
+def test_ring_bounds_and_drop_count():
+    t = Tracer(capacity=4)
+    t.enable()
+    for i in range(10):
+        with t.span("s", i=i):
+            pass
+    assert t.dropped == 6
+    kept = spans(t.chrome_events(), "s")
+    assert [e["args"]["i"] for e in kept] == [6, 7, 8, 9]
+    # the cursor keeps counting past drops
+    assert t.cursor() == 10
+
+
+def test_cursor_slices_and_pid_filter():
+    t = Tracer()
+    t.enable()
+    with t.span("old", pid=1):
+        pass
+    cur = t.cursor()
+    with t.span("mine", pid=2):
+        pass
+    with t.span("shared", pid=0):
+        pass
+    events = t.chrome_events(since=cur, pids={0, 2})
+    names = {e["name"] for e in spans(events)}
+    assert names == {"mine", "shared"}
+
+
+def test_metadata_rows_and_export(tmp_path):
+    t = Tracer()
+    t.enable()
+    with t.span("pack", tid="pack", pid=0):
+        pass
+    with t.span("host_exec", tid="host", pid=5):
+        pass
+    events = t.chrome_events()
+    meta = [e for e in events if e["ph"] == "M"]
+    proc_names = {
+        e["pid"]: e["args"]["name"]
+        for e in meta
+        if e["name"] == "process_name"
+    }
+    assert proc_names == {0: "analysis", 5: "job 5"}
+    thread_names = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in meta
+        if e["name"] == "thread_name"
+    }
+    assert thread_names[(0, 1)] == "pack"
+    assert thread_names[(5, 1)] == "host"
+
+    path = tmp_path / "trace.json"
+    n = t.export(str(path))
+    doc = json.loads(path.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    assert len(doc["traceEvents"]) == n
+    assert all(REQUIRED_KEYS <= set(e.keys()) for e in doc["traceEvents"])
+
+
+def test_enable_resets_epoch_only_when_newly_enabled():
+    t = Tracer()
+    t.enable()
+    epoch = t._epoch
+    t.enable()  # already on: epoch stable so ts stays monotonic
+    assert t._epoch == epoch
