@@ -175,13 +175,46 @@ def _steady_analysis(
     issues = fire_lasers(sym)
     meter.close()
     pruned = 0
+    tpu = {}
     if strategy == "tpu-batch":
         from mythril_tpu.laser.tpu.backend import find_tpu_strategy
 
         tpu_strategy = find_tpu_strategy(sym.laser.strategy)
         if tpu_strategy is not None:
             pruned = tpu_strategy.static_pruned_lanes
-    return meter, sorted({i.swc_id for i in issues}), pruned
+            # fused-loop residency accounting (ISSUE 14): how much of
+            # the measured wall the batch spent device-resident, and how
+            # many device rounds each host sync amortized
+            syncs = tpu_strategy.fused_syncs
+            ks = sorted(tpu_strategy.fused_k_samples)
+            tpu = {
+                "device_residency_pct": round(
+                    100.0
+                    * tpu_strategy.device_wall_s
+                    / max(meter.wall, 1e-9),
+                    1,
+                ),
+                "rounds_per_host_sync": (
+                    None
+                    if not syncs
+                    else round(tpu_strategy.fused_rounds / syncs, 2)
+                ),
+                "fused_k_p50": _sample_pct(ks, 50),
+                "fused_k_p95": _sample_pct(ks, 95),
+                "device_pruned_lanes": tpu_strategy.device_pruned_lanes,
+            }
+    return meter, sorted({i.swc_id for i in issues}), pruned, tpu
+
+
+def _sample_pct(sorted_samples, q):
+    """Nearest-rank percentile over a small pre-sorted sample list."""
+    if not sorted_samples:
+        return None
+    idx = min(
+        len(sorted_samples) - 1,
+        max(0, int(round(q / 100.0 * (len(sorted_samples) - 1)))),
+    )
+    return sorted_samples[idx]
 
 
 def _device_states_per_sec(code: bytes, lanes: int) -> float:
@@ -326,6 +359,11 @@ def _emit(progress: dict) -> None:
                     "integrated_static_pruned_lanes"
                 ),
                 "trace_overhead_pct": progress.get("trace_overhead_pct"),
+                "device_residency_pct": progress.get("device_residency_pct"),
+                "rounds_per_host_sync": progress.get("rounds_per_host_sync"),
+                "fused_k_p50": progress.get("fused_k_p50"),
+                "fused_k_p95": progress.get("fused_k_p95"),
+                "device_pruned_lanes": progress.get("device_pruned_lanes"),
                 "round_phase_p50_ms": progress.get("round_phase_p50_ms"),
                 "round_phase_p95_ms": progress.get("round_phase_p95_ms"),
                 "lanes": progress.get("lanes"),
@@ -557,7 +595,7 @@ def main() -> int:
 
     progress = {"protocol": "steady-state-v1"}
     _phase("host baseline (stress contract, bfs tx=2 budget=60)")
-    host_meter, _, _ = _steady_analysis(
+    host_meter, _, _, _ = _steady_analysis(
         creation_hex, runtime.hex(), "bfs", 2, 60, "BECStress"
     )
     progress["host_states_per_sec"] = host_meter.states_per_s
@@ -577,12 +615,17 @@ def main() -> int:
 
     _phase("integrated tpu-batch pipeline (stress contract, tx=2 budget=60)")
     solver_base = _solver_snapshot()
-    meter, integrated_swcs, integrated_pruned = _steady_analysis(
-        creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
+    meter, integrated_swcs, integrated_pruned, integrated_tpu = (
+        _steady_analysis(
+            creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
+        )
     )
     progress["integrated_states_per_sec"] = meter.states_per_s
     progress["integrated_swcs"] = integrated_swcs
     progress["integrated_static_pruned_lanes"] = integrated_pruned
+    # fused device-loop residency on the becstress row (ISSUE 14
+    # acceptance: rounds_per_host_sync >= 8 here on accelerators)
+    progress.update(integrated_tpu)
     progress.update(_solver_delta(solver_base))
     _checkpoint(progress)
 
@@ -606,19 +649,20 @@ def main() -> int:
         + bec_runtime.hex()
     )
     _phase("host baseline (BECToken, bfs tx=3 budget=120)")
-    bec_host_meter, _, _ = _steady_analysis(
+    bec_host_meter, _, _, _ = _steady_analysis(
         bec_creation, bec_runtime.hex(), "bfs", 3, 120, "BECToken"
     )
     progress["bectoken_host_states_per_sec"] = bec_host_meter.states_per_s
     _checkpoint(progress)
     _phase("integrated tpu-batch pipeline (BECToken, tx=3 budget=120)")
     bec_solver_base = _solver_snapshot()
-    bec_meter, bec_swcs, bec_pruned = _steady_analysis(
+    bec_meter, bec_swcs, bec_pruned, bec_tpu = _steady_analysis(
         bec_creation, bec_runtime.hex(), "tpu-batch", 3, 120, "BECToken"
     )
     progress["bectoken_states_per_sec"] = bec_meter.states_per_s
     progress["bectoken_swcs"] = bec_swcs
     progress["bectoken_solver"] = _solver_delta(bec_solver_base)
+    progress["bectoken_tpu"] = bec_tpu
     # cost/benefit of the static pre-analysis pass: its cumulative wall
     # time across every analysis in this process, and the device fork
     # children it pruned on the north-star BECToken row
@@ -647,7 +691,7 @@ def main() -> int:
 
     obs.TRACER.enable()
     try:
-        traced_meter, _, _ = _steady_analysis(
+        traced_meter, _, _, _ = _steady_analysis(
             creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
         )
     finally:

@@ -27,6 +27,7 @@ the host path — the loop degrades gracefully to pure host execution.
 """
 
 import logging
+import os
 import threading
 import time
 from typing import List, Optional
@@ -131,6 +132,16 @@ class TpuBatchStrategy(BasicSearchStrategy):
         # and continued their packed states on the host path
         self.device_retries = 0
         self.degraded_rounds = 0
+        # fused megakernel accounting (laser/tpu/megakernel.py): device
+        # rounds retired inside fused super-round dispatches, host syncs
+        # paid for them, per-dispatch round counts (the fused_k_p50/p95
+        # bench distribution), lanes pruned on device without a lift,
+        # and the cumulative device wall feeding device_residency_pct
+        self.fused_rounds = 0
+        self.fused_syncs = 0
+        self.fused_k_samples: List[int] = []
+        self.device_pruned_lanes = 0
+        self.device_wall_s = 0.0
         # device-side SWC candidate sites: statically-flagged pcs
         # (CodeBank.swc_mask) some device lane actually visited this
         # analysis, keyed by SWC id. Candidates, not findings — the host
@@ -544,6 +555,21 @@ def _do_warmup(key, event) -> None:
         st = transfer.batch_to_device(np_batch, cfg)
         cb = make_code_bank([b"\x00"], cfg.code_len, host_ops=(), freeze_errors=True)
         out, _hist = _run_device(cb, st, cfg, want_stats=want_stats)
+        # _run_device warmed whichever loop the current policy selects
+        # (normally the fused megakernel). On the BACKGROUND-thread path
+        # also warm the synchronous slice loop: the breaker's half-open
+        # trial rounds run it, and a trial that pays the XLA compile
+        # inline would look exactly like the wedged device it is probing
+        # for. A synchronous caller (the test suite, warmup_device)
+        # blocks on this function, so it warms only the selected loop —
+        # the fallback compiles lazily if the degrade ladder ever runs.
+        if WARMUP_ASYNC and _fused_enabled():
+            if want_stats:
+                out, _ = run_with_stats(
+                    cb, default_env(), out, max_steps=DEVICE_SLICE_STEPS
+                )
+            else:
+                out = run(cb, default_env(), out, max_steps=DEVICE_SLICE_STEPS)
         transfer.batch_to_host(out)
         from mythril_tpu.smt import terms as _terms
 
@@ -603,6 +629,65 @@ def _warn_mesh_stats_once() -> None:
 # minutes on a slow backend, silently overshooting --execution-timeout;
 # slicing bounds the overshoot to one slice's wall time
 DEVICE_SLICE_STEPS = 512
+
+# -- fused megakernel policy (laser/tpu/megakernel.py) -----------------
+#
+# "auto" fuses the single-device path and drops back to the synchronous
+# slice loop while the circuit breaker is half-open — the trial round
+# probes the device through the simpler machinery, and only a closed
+# breaker re-admits the fused loop (docs/DEVICE_LOOP.md degrade ladder).
+# "on"/"off" force the choice; MYTHRIL_TPU_FUSED overrides per process.
+FUSED_MODE = "auto"
+FUSED_K_MIN = 8
+FUSED_K_MAX = 64
+# super-round depth before any phase history exists to adapt from
+FUSED_K_DEFAULT = 16
+
+# EMA of device wall seconds per fused round — the adaptive-K
+# controller's denominator, updated after every fused dispatch
+_fused_round_cost_s = [0.0]
+
+
+def _fused_enabled() -> bool:
+    mode = os.environ.get("MYTHRIL_TPU_FUSED", FUSED_MODE).lower()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return _retry.BREAKER.state() != "half-open"
+
+
+def _pick_fused_k() -> int:
+    """Adaptive super-round depth K.
+
+    Stay on device while the host side of one sync under-fills the
+    device budget: K ~ (host_exec + lift + solve p95 per sync) / (EMA
+    device seconds per fused round), clamped to [FUSED_K_MIN,
+    FUSED_K_MAX]. Until either side has history the default applies.
+    MYTHRIL_TPU_FUSED_K pins K for benchmarking. K is passed TRACED
+    into the megakernel, so adaptation never recompiles."""
+    env_k = os.environ.get("MYTHRIL_TPU_FUSED_K")
+    if env_k:
+        try:
+            return max(1, int(env_k))
+        except ValueError:
+            log.warning("bad MYTHRIL_TPU_FUSED_K=%r ignored", env_k)
+    cost = _fused_round_cost_s[0]
+    host = 0.0
+    for ph in ("host_exec", "lift", "solve"):
+        v = _cat.ROUND_PHASE_S.percentile(95, ph)
+        if v:
+            host += v
+    if not cost or not host:
+        return FUSED_K_DEFAULT
+    return int(min(FUSED_K_MAX, max(FUSED_K_MIN, round(host / cost))))
+
+
+def planned_fused_k() -> int:
+    """The K the next guarded round will run — robustness/retry.py
+    scales its round watchdog by this so a fused super-round is never
+    mistaken for a wedged device."""
+    return _pick_fused_k() if _fused_enabled() else 1
 
 
 def _drain_ss_rings(bridge, st):
@@ -678,12 +763,23 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
     from mythril_tpu.laser.tpu import mesh as mesh_lib
     from mythril_tpu.laser.tpu.batch import RUNNING as _RUNNING
 
+    if bridge is not None:
+        # reset the fused-round stash: a long-lived bridge (the shared
+        # coordinator's) must not replay a PREVIOUS round's fused stats
+        # into exec_batch when this round runs the sync/mesh path
+        bridge.fused_round_info = None
+        bridge.fused_pruned_visited = None
     devices = jax.devices()
     n_shards = len(devices)
     if (
         not _use_mesh(n_shards, devices[0].platform)
         or cfg.lanes % n_shards != 0
     ):
+        if _fused_enabled():
+            return _run_device_fused(
+                cb, st, cfg, want_stats=want_stats, deadline=deadline,
+                bridge=bridge,
+            )
         import jax.numpy as jnp
 
         hist = None
@@ -738,6 +834,103 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
         if deadline is not None and time.time() > deadline:
             break
     return st, None
+
+
+def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
+    """Single-device fused path: up to K device rounds retire inside ONE
+    ``lax.while_loop`` dispatch (megakernel.run_fused) — fork, verdict
+    pruning, and lane compaction all happen on device, and the host
+    syncs once per dispatch instead of once per 512-step slice.
+
+    The host loop here only re-dispatches when lanes frozen at storage-
+    ring overflow (TRAP_SS) resume after a spill-chain drain, or when a
+    deadline clamp cut the dispatch short — both are coarse-grained
+    events, so ``rounds_per_host_sync`` stays ~K. Per-dispatch stats
+    (rounds retired, lanes pruned on device, their step/coverage
+    accumulators) ride back to exec_batch on the bridge."""
+    import jax.numpy as jnp
+
+    from mythril_tpu.laser.tpu import megakernel
+    from mythril_tpu.laser.tpu.batch import RUNNING as _RUNNING
+
+    k = _pick_fused_k()
+    rounds_left = k
+    hist = None
+    pruned_visited = None
+    totals = {
+        "k": k,
+        "rounds": 0,
+        "syncs": 0,
+        "k_samples": [],
+        "pruned_lanes": 0,
+        "pruned_steps": 0,
+        "pruned_static": 0,
+        "device_wall_s": 0.0,
+    }
+    while rounds_left > 0:
+        dispatch = rounds_left
+        if deadline is not None:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            cost = _fused_round_cost_s[0]
+            if cost > 0:
+                # clamp the dispatch so the while_loop cannot overshoot
+                # --execution-timeout by more than ~one round's wall
+                dispatch = min(dispatch, max(1, int(remaining / cost)))
+        _cat.DEVICE_SLICES_TOTAL.inc()
+        t0 = time.time()
+        fo = megakernel.run_fused(
+            cb,
+            default_env(),
+            st,
+            max_rounds=dispatch,
+            steps_per_round=DEVICE_SLICE_STEPS,
+            with_stats=want_stats,
+        )
+        st = fo.st
+        stats = megakernel.decode_info(fo.info)  # the one blocking fetch
+        wall = time.time() - t0
+        totals["syncs"] += 1
+        totals["rounds"] += stats.rounds
+        totals["k_samples"].append(stats.rounds)
+        totals["pruned_lanes"] += stats.pruned_lanes
+        totals["pruned_steps"] += stats.pruned_steps
+        totals["pruned_static"] += stats.pruned_static
+        totals["device_wall_s"] += wall
+        if stats.pruned_lanes:
+            pv = np.asarray(fo.pruned_visited)
+            pruned_visited = (
+                pv if pruned_visited is None else (pruned_visited | pv)
+            )
+        if want_stats:
+            hist = fo.hist if hist is None else hist + fo.hist
+        if stats.rounds:
+            sample = wall / stats.rounds
+            prev = _fused_round_cost_s[0]
+            _fused_round_cost_s[0] = (
+                sample if not prev else 0.5 * prev + 0.5 * sample
+            )
+            # S3: the round_phase histogram stays meaningful under
+            # fusion — one synthetic per-round observation per fused
+            # iteration under its own label, so the super-round's
+            # "device_round" phase keeps its true wall time and the
+            # per-round cost stays queryable
+            for _ in range(stats.rounds):
+                _cat.ROUND_PHASE_S.observe(sample, "device_round_iter")
+                obs.TRACER.cut(
+                    "fused_round", "device_round_iter", rounds=stats.rounds
+                )
+            obs.TRACER.end_cut("fused_round")
+        rounds_left -= max(1, stats.rounds)
+        if bridge is not None:
+            st = _drain_ss_rings(bridge, st)
+        if not bool(np.asarray(st.alive & (st.status == _RUNNING)).any()):
+            break
+    if bridge is not None:
+        bridge.fused_round_info = totals
+        bridge.fused_pruned_visited = pruned_visited
+    return st, hist
 
 
 def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
@@ -1228,6 +1421,27 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 laser.iprof.record_device_round(counts, device_wall)
         strategy.device_rounds += 1
         _cat.DEVICE_ROUNDS_TOTAL.inc()
+        strategy.device_wall_s += device_wall
+        # fused super-round accounting (megakernel.py, stashed on the
+        # bridge by _run_device_fused): rounds retired per host sync and
+        # the on-device prune accumulators. In a SHARED round the prune
+        # accumulators cannot be split per job (the pruned lanes' job
+        # ids died with them), so only the single-tenant path folds them
+        # into counters/coverage; the shared path loses a little metric
+        # attribution, never correctness.
+        fused = getattr(bridge, "fused_round_info", None)
+        fused_pv = getattr(bridge, "fused_pruned_visited", None)
+        if fused:
+            strategy.fused_rounds += fused["rounds"]
+            strategy.fused_syncs += fused["syncs"]
+            strategy.fused_k_samples.extend(fused["k_samples"])
+            if job_ctx is not None and fused["rounds"]:
+                # S1: a K-fused super-round must not silently widen the
+                # checkpoint cadence — credit the journal so the next
+                # stop_sym_trans snapshots once credits cover one period
+                from mythril_tpu.robustness import checkpoint as _ckpt
+
+                _ckpt.credit_rounds(job_ctx.job_id, fused["rounds"])
         # harvest split: in a shared round only the lanes stamped with
         # THIS job's id feed its counters/coverage — other tenants'
         # lanes (alive or dead) belong to their own accounting
@@ -1235,12 +1449,17 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         if job_mask is None:
             _steps = int(np.asarray(out.steps).sum())
             strategy.ss_drains += bridge.ss_drain_count
+            if fused:
+                _steps += fused["pruned_steps"]
+                strategy.static_pruned_lanes += fused["pruned_static"]
+                strategy.device_pruned_lanes += fused["pruned_lanes"]
         else:
             own_alive = own_alive & job_mask
             _steps = int(np.asarray(out.steps)[job_mask].sum())
             strategy.ss_drains += bridge.ss_drains_by_job.get(
                 job_ctx.job_id, 0
             )
+            fused_pv = None
         strategy.device_steps_retired += _steps
         _cat.DEVICE_STEPS_TOTAL.inc(_steps)
         strategy.static_pruned_lanes += int(
@@ -1255,9 +1474,18 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 code_ids = np.asarray(out.code_id)
                 for code_id, code_bytes in enumerate(bridge.codes):
                     lanes_mask = own_alive & (code_ids == code_id)
-                    if not lanes_mask.any():
+                    # lanes pruned ON DEVICE (megakernel revert prune)
+                    # left no lane to read — their coverage rides the
+                    # fused loop's pruned_visited union instead
+                    union = None
+                    if lanes_mask.any():
+                        union = visited[lanes_mask].any(axis=0)
+                    if fused_pv is not None and code_id < fused_pv.shape[0]:
+                        row = fused_pv[code_id]
+                        union = row if union is None else (union | row)
+                    if union is None:
                         continue
-                    offsets = np.nonzero(visited[lanes_mask].any(axis=0))[0]
+                    offsets = np.nonzero(union)[0]
                     if offsets.size == 0:
                         continue
                     for hook in laser._device_coverage_hooks:
@@ -1273,7 +1501,12 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             swc_code_ids = np.asarray(out.code_id)
             for code_id, code_bytes in enumerate(bridge.codes):
                 lanes_mask = own_alive & (swc_code_ids == code_id)
-                if not lanes_mask.any():
+                has_pruned = (
+                    fused_pv is not None
+                    and code_id < fused_pv.shape[0]
+                    and fused_pv[code_id].any()
+                )
+                if not lanes_mask.any() and not has_pruned:
                     continue
                 try:
                     mask = static_pass.analyze(code_bytes).swc_mask
@@ -1282,6 +1515,8 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                     continue
                 width = min(len(mask), swc_visited.shape[1])
                 union = swc_visited[lanes_mask][:, :width].any(axis=0)
+                if has_pruned:
+                    union = union | fused_pv[code_id][:width]
                 hit = mask[:width][union]
                 if hit.size == 0:
                     continue
@@ -1327,8 +1562,14 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
         with obs.phase("solve", pid=_pid):
             feasible = filter_feasible(resumed_states)
         laser.work_list.extend(_apply_loop_bound(laser, feasible))
-        # device-born forks add to the explored-state count
-        laser.total_states += max(0, int(own_alive.sum()) - len(packed_states))
+        # device-born forks add to the explored-state count — including
+        # forks that lived and died entirely on device (revert prune)
+        _born_dead = (
+            fused["pruned_lanes"] if fused and job_mask is None else 0
+        )
+        laser.total_states += max(
+            0, int(own_alive.sum()) + _born_dead - len(packed_states)
+        )
     obs.TRACER.end_cut("round", pid=_pid)
     if strategy.device_rounds == 0 and not device_ready(cfg, want_stats):
         if _warmup_attempted(cfg, want_stats):

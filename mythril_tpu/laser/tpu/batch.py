@@ -121,6 +121,15 @@ class CodeBank(NamedTuple):
     # round to surface device-side candidate sites per SWC class, with
     # the host detection modules as the authoritative confirm
     swc_mask: jnp.ndarray  # u8[n_codes, code_len]
+    # taint/interval MUST branch facts per JUMPI byte-pc (tables.py
+    # jumpi_verdict: 1 = condition provably nonzero, 2 = provably zero,
+    # 0 = unknown). The step kernel applies these at symbolic JUMPIs:
+    # a must-take lane jumps in place (path sign True, no fork) and a
+    # must-fall-through lane suppresses its taken child — the branch the
+    # verdict contradicts is UNSAT, so no lane, no lift, and no solver
+    # call are ever spent on it. The host-side contradiction seeding in
+    # bridge.py stays as the check for host-forked states.
+    jumpi_verdict: jnp.ndarray  # i8[n_codes, code_len]
 
 
 class Env(NamedTuple):
@@ -339,6 +348,7 @@ def make_code_bank(
     jd = np.zeros((n, code_len), dtype=bool)
     mrev = np.zeros((n, code_len), dtype=bool)
     swc = np.zeros((n, code_len), dtype=np.uint8)
+    jvrd = np.zeros((n, code_len), dtype=np.int8)
     pimm = np.zeros((n, code_len, words.NDIGITS), dtype=np.uint32)
     for i, c in enumerate(codes):
         if len(c) > code_len:
@@ -349,6 +359,9 @@ def make_code_bank(
         jd[i, : len(c)] = analysis.jumpdest_bitmap
         mrev[i, : len(c)] = analysis.must_revert_pc
         swc[i, : len(c)] = analysis.swc_mask
+        verdict = getattr(analysis, "jumpi_verdict", None)
+        if verdict is not None:
+            jvrd[i, : len(c)] = verdict
         # Pre-decode PUSH immediates (truncated pushes zero-pad on the
         # right, matching the EVM's implicit zero bytes past code end).
         pc = 0
@@ -375,6 +388,7 @@ def make_code_bank(
         must_revert=jnp.asarray(mrev),
         prune_revert=jnp.asarray(bool(prune_revert)),
         swc_mask=jnp.asarray(swc),
+        jumpi_verdict=jnp.asarray(jvrd),
     )
 
 

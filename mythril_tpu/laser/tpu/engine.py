@@ -831,7 +831,21 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         & (dest32 < my_code_len)
         & cb.jumpdest[st.code_id, jnp.clip(dest32, 0, CL - 1)]
     )
-    taken = (is_jump | (is_jumpi & ~cond_sym & ~words.is_zero(b))) & ~has_a
+    # MUST branch facts at symbolic JUMPIs (CodeBank.jumpi_verdict, from
+    # the taint/interval pass): the contradicted branch is UNSAT, so it
+    # is never materialized. A must-take lane jumps IN PLACE (the path
+    # entry commits with sign True — the same entry its forked child
+    # would have carried) and spawns no fall-through; a must-fall lane
+    # continues past the JUMPI and suppresses its taken child below.
+    # Exact pruning, no soundness gate needed: the host applies the same
+    # verdict via bridge._static_unsat -> solver must-UNSAT, it just
+    # pays a lane, a lift and a decide_batch slot to do it.
+    verdict = cb.jumpi_verdict[st.code_id, jnp.clip(st.pc, 0, CL - 1)]
+    must_take = cond_sym & (verdict == 1) & dest_ok
+    must_fall = cond_sym & (verdict == 2)
+    taken = (
+        (is_jump | (is_jumpi & ~cond_sym & ~words.is_zero(b))) & ~has_a
+    ) | must_take
     jump_err = taken & ~dest_ok
 
     pc_next = st.pc + 1 + jnp.where(is_push, k_push, 0)
@@ -849,7 +863,10 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         jnp.where(path_append, sym_b, st.path_id[lane, pwidx])
     )
     new_path_sign = st.path_sign.at[lane, pwidx].set(
-        jnp.where(path_append, False, st.path_sign[lane, pwidx])
+        # the appended sign is the direction the lane CONTINUES in:
+        # False for the normal fall-through, True when a MUST verdict
+        # makes the lane take the branch in place
+        jnp.where(path_append, must_take, st.path_sign[lane, pwidx])
     )
     new_path_meta = st.path_meta.at[lane, pwidx].set(
         jnp.where(
@@ -863,19 +880,20 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # a lane that will OOG on the JUMPI itself must not consume a fork
     # rank (it would spuriously starve a later forking lane); JUMPI's cost
     # is purely static, so the check is exact here
-    fork_want = path_append & dest_ok & (st.gas_left >= static_gas)
+    fork_want = path_append & dest_ok & (st.gas_left >= static_gas) & ~must_take
     # static must-revert pruning: when the taken branch enters a block the
     # static pass proved runs only device-pure ops into REVERT, the child
     # is suppressed instead of forked — but only for outermost frames
     # (a reverting outermost state is discarded by the host's transaction
     # finalization with no observable effect, so no hook, no solver call,
     # and no lane are ever spent on it). Armed per-analysis by the
-    # backend (prune_revert gate in exec_batch).
+    # backend (prune_revert gate in exec_batch). A must-fall verdict
+    # suppresses the taken child the same way (its path is UNSAT).
     prune_child = (
         cb.prune_revert
         & st.outermost
         & cb.must_revert[st.code_id, jnp.clip(dest32, 0, CL - 1)]
-    )
+    ) | must_fall
     fork_base = fork_want & ~prune_child
     free = ~st.alive
     nfree = jnp.sum(free.astype(I32))
@@ -1183,11 +1201,15 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
         seed_id=st.seed_id,
         job_id=st.job_id,
         outermost=st.outermost,
-        # count each suppressed child on the lane that would have forked
-        # it — the path-tape append still commits (the fall-through keeps
-        # ¬cond), only the taken-branch lane is elided
+        # count each statically-eliminated branch on the lane that kept
+        # the other one: a suppressed taken child (must-revert landing or
+        # must-fall verdict — the path-tape append still commits, the
+        # fall-through keeps ¬cond), or the fall-through a must-take
+        # verdict made the lane abandon by jumping in place
         static_pruned=st.static_pruned
-        + (fork_want & prune_child & committed).astype(I32),
+        + (((fork_want & prune_child) | (must_take & path_append)) & committed).astype(
+            I32
+        ),
     )
 
     # ------------------------------------------------------------------

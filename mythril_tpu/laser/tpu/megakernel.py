@@ -1,0 +1,235 @@
+"""Device-resident fused round loop: step -> prune -> compact, K times.
+
+The integrated pipeline's dominant cost is the seam, not the stepping:
+after every device round the backend returns to host for quiescence
+checks, ring drains, lift and re-pack (BENCH_r05: ~350x gap between the
+raw step kernel and end-to-end throughput). This module keeps the batch
+resident by fusing K symbolic-execution rounds into ONE ``lax.while_loop``
+dispatch:
+
+  round body  = ``steps_per_round`` engine steps (forks included — the
+                step kernel's free-lane cumsum already places children)
+  then prune  = kill lanes frozen at an outermost REVERT while static
+                must-revert pruning is armed (``CodeBank.prune_revert``)
+  then compact = stable-sort the lanes so the alive frontier is a prefix
+
+  loop cond   = rounds < max_rounds  AND  any lane still RUNNING
+
+The cond is the per-lane ``needs_host`` reduction from the design note:
+a lane is RUNNING, halted, or frozen at a host-routed op (TRAP /
+TRAP_SS).  ``~any(RUNNING)`` is exactly "every alive lane needs the host
+or is done", so the loop exits to host only when the frontier drains or
+every survivor is waiting on a host op — never one round per sync.
+
+Prune soundness: with ``prune_revert`` armed the backend guaranteed no
+REVERT pre/post hooks exist and gas is not tracked.  An outermost frame
+that reverts is discarded by the host's ``_finalize_transaction`` with
+``committed = False`` — no ``check_potential_issues`` settlement, no
+open world state — and every hook-replayed finding parks on the
+discarded state (settlement detectors like integer settle at
+STOP/RETURN, which a lane frozen AT the REVERT byte can never reach).
+Killing the lane on device therefore produces the same observable
+result as lifting it, replaying its hooks, and watching the host throw
+the frame away — minus the lift.  The lane's coverage/counter planes
+are folded into the fused-loop accumulators below so measurement parity
+survives the skip.
+
+Compaction soundness: every ``StateBatch`` plane is lane-major
+(``batch_shapes``: leading dim L), and the host lift resolves all
+staged metadata through the ``seed_id``/``spill_id``/``job_id`` PLANES,
+never through raw lane positions — so a lane permutation is invisible
+to the bridge.  A stable argsort on ``~alive`` keeps relative lane
+order among survivors (the S2 property test pins this down) and makes
+the alive frontier a dense prefix, which later forks refill and the
+host download can slice.
+"""
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mythril_tpu import obs
+from mythril_tpu.laser.tpu.batch import (
+    RUNNING,
+    REVERTED,
+    TRAP,
+    CodeBank,
+    Env,
+    StateBatch,
+)
+from mythril_tpu.laser.tpu.engine import step
+
+I32 = jnp.int32
+
+# byte opcode a lane freezes at when REVERT is host-routed (backend
+# _ALWAYS_HOST): in the integrated pipeline a reverting lane never
+# reaches status REVERTED — it TRAPs AT the REVERT instruction. Direct
+# engine runs (host_ops without REVERT) do reach REVERTED; the prune
+# mask accepts both encodings.
+REVERT_OP = 0xFD
+
+
+class FusedOut(NamedTuple):
+    """Result of one fused super-round dispatch."""
+
+    st: StateBatch
+    # i32[6] packed scalars — ONE host fetch decodes all of them:
+    # [rounds_done, pruned_lanes, pruned_steps, pruned_static,
+    #  n_alive, n_running]
+    info: jnp.ndarray
+    # bool[n_codes, code_len] union of PRUNED lanes' visited planes —
+    # their coverage must still be harvested (measurement parity with
+    # the host path, which would have lifted them before discarding)
+    pruned_visited: jnp.ndarray
+    # u32[256] retired-opcode histogram (with_stats) or u32[1] dummy
+    hist: jnp.ndarray
+
+
+def prune_mask(cb: CodeBank, st: StateBatch) -> jnp.ndarray:
+    """bool[L]: lanes whose lift is provably unobservable this round."""
+    at_revert = (st.status == REVERTED) | (
+        (st.status == TRAP) & (st.trap_op == REVERT_OP)
+    )
+    return st.alive & st.outermost & cb.prune_revert & at_revert
+
+
+def compact_impl(st: StateBatch) -> StateBatch:
+    """Permute lanes so the alive frontier is a dense prefix.
+
+    Stable sort on the dead flag: survivors keep their relative order,
+    dead lanes (free fork slots) sink to the suffix. Every plane is
+    lane-major, so one gather order applies to the whole pytree."""
+    order = jnp.argsort(st.alive.astype(I32), descending=True, stable=True)
+    return jax.tree_util.tree_map(lambda x: x[order], st)
+
+
+compact = jax.jit(compact_impl, donate_argnames=("st",))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("steps_per_round", "with_stats"),
+    donate_argnames=("st",),
+)
+def _fused_impl(
+    cb: CodeBank,
+    env: Env,
+    st: StateBatch,
+    max_rounds,
+    steps_per_round: int = 512,
+    with_stats: bool = False,
+) -> FusedOut:
+    """The megakernel body. ``max_rounds`` is TRACED (a runtime scalar),
+    so the adaptive-K controller never triggers a recompile; only
+    ``steps_per_round``/``with_stats`` specialize the kernel."""
+    CL = cb.code.shape[1]
+    n_codes = cb.code.shape[0]
+    W = st.visited.shape[1]
+
+    def cond(carry):
+        r, s, _pl, _ps, _px, _pv, _hist = carry
+        # needs_host reduction: RUNNING lanes still make device
+        # progress; everything else is halted or frozen at a host op
+        return (r < max_rounds) & jnp.any(s.alive & (s.status == RUNNING))
+
+    def body(carry):
+        r, s, pl, ps, px, pv, hist = carry
+
+        def one_step(_, inner):
+            s2, h = inner
+            ns = step(cb, env, s2)
+            if with_stats:
+                op = cb.code[s2.code_id, jnp.clip(s2.pc, 0, CL - 1)].astype(
+                    I32
+                )
+                idx = jnp.where(ns.steps > s2.steps, op, 256)  # 256 = dropped
+                h = h.at[idx].add(1, mode="drop")
+            return ns, h
+
+        s, hist = jax.lax.fori_loop(0, steps_per_round, one_step, (s, hist))
+
+        # prune: fold the dying lanes' observable counters into the
+        # carry accumulators before the kill — the host merges them so
+        # steps/coverage/static-prune accounting matches the lift path
+        dead = prune_mask(cb, s)
+        pl = pl + jnp.sum(dead.astype(I32))
+        ps = ps + jnp.sum(jnp.where(dead, s.steps, 0))
+        px = px + jnp.sum(jnp.where(dead, s.static_pruned, 0))
+        pv = pv.at[s.code_id].max(dead[:, None] & s.visited)
+        # zero the dying lanes' counter planes: the host sums steps/
+        # static_pruned over ALL lanes, so a stale copy left in a free
+        # lane would double-count against the accumulators above
+        s = s._replace(
+            alive=s.alive & ~dead,
+            steps=jnp.where(dead, 0, s.steps),
+            static_pruned=jnp.where(dead, 0, s.static_pruned),
+            visited=jnp.where(dead[:, None], False, s.visited),
+        )
+        s = compact_impl(s)
+        return r + 1, s, pl, ps, px, pv, hist
+
+    zero = jnp.asarray(0, I32)
+    hist0 = jnp.zeros((256 if with_stats else 1,), jnp.uint32)
+    pv0 = jnp.zeros((n_codes, W), jnp.bool_)
+    r, out, pl, ps, px, pv, hist = jax.lax.while_loop(
+        cond, body, (zero, st, zero, zero, zero, pv0, hist0)
+    )
+    n_alive = jnp.sum(out.alive.astype(I32))
+    n_running = jnp.sum((out.alive & (out.status == RUNNING)).astype(I32))
+    info = jnp.stack([r, pl, ps, px, n_alive, n_running])
+    return FusedOut(st=out, info=info, pruned_visited=pv, hist=hist)
+
+
+class FusedStats(NamedTuple):
+    """Host-side decode of :class:`FusedOut.info`."""
+
+    rounds: int
+    pruned_lanes: int
+    pruned_steps: int
+    pruned_static: int
+    n_alive: int
+    n_running: int
+
+
+def run_fused(
+    cb: CodeBank,
+    env: Env,
+    st: StateBatch,
+    max_rounds: int,
+    steps_per_round: int = 512,
+    with_stats: bool = False,
+) -> FusedOut:
+    """Dispatch one fused super-round (up to ``max_rounds`` device
+    rounds without a host sync). The caller owns the single host fetch
+    of ``out.info`` — nothing here blocks on device results."""
+    with obs.TRACER.span(
+        "fused_super_round",
+        tid="device",
+        max_rounds=int(max_rounds),
+        steps_per_round=steps_per_round,
+    ):
+        return _fused_impl(
+            cb,
+            env,
+            st,
+            jnp.asarray(int(max_rounds), I32),
+            steps_per_round=steps_per_round,
+            with_stats=with_stats,
+        )
+
+
+def decode_info(info) -> FusedStats:
+    """ONE blocking device->host fetch for all fused-round scalars."""
+    import numpy as np
+
+    vals = np.asarray(info)  # noqa: device_loop_purity — host-side decode
+    return FusedStats(
+        rounds=int(vals[0]),
+        pruned_lanes=int(vals[1]),
+        pruned_steps=int(vals[2]),
+        pruned_static=int(vals[3]),
+        n_alive=int(vals[4]),
+        n_running=int(vals[5]),
+    )

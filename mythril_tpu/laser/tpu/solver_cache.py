@@ -498,6 +498,11 @@ _STAT_KEYS = (
     "async_completed",
     "async_dropped",
     "static_unsat_seeds",
+    # decide_batch invocations that carried a non-empty frontier: with
+    # the fused megakernel one invocation covers a whole K-round
+    # super-round, so queries/round_batches exposes the dispatch
+    # batching the fusion buys (ISSUE 14 solver seam)
+    "round_batches",
 )
 
 
@@ -698,6 +703,8 @@ class SolverCache:
         n = len(sets)
         _span = obs.TRACER.begin("decide_batch", tid="solve", n=n)
         self._count("queries", n)
+        if n:
+            self._count("round_batches")
         verdicts: List[Optional[bool]] = [None] * n
         keys: List[Optional[frozenset]] = [None] * n
         digests: List[object] = [_NO_DIGEST] * n

@@ -101,6 +101,16 @@ def _bucket(n: int, cap: int) -> int:
     return cap
 
 
+def _lane_bucket(n: int, cap: int) -> int:
+    """Smallest power-of-two lane count covering ``n`` (min 16, max
+    ``cap``): power-of-two buckets keep the flattener's jit-variant
+    count logarithmic in the lane dimension."""
+    b = 16
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
 # skippable plane groups for the upload. Presence is tracked per GROUP
 # (one bit each), not per plane: the presence tuple is part of the
 # splitter's static jit key, so per-plane granularity would let the
@@ -291,19 +301,38 @@ def batch_to_host(st: StateBatch) -> StateBatch:
     planes = _unpack_host(np.asarray(_flatten_device(st, small)), small_shapes)
 
     cap = int(st.tape_op.shape[1])
-    t_used = (
-        cap if monomorphic() else _bucket(int(planes["tape_len"].max()), cap)
-    )
+    L = int(st.alive.shape[0])
+    l_used = None
+    if monomorphic():
+        t_used = cap
+    else:
+        t_used = _bucket(int(planes["tape_len"].max()), cap)
+        # alive-prefix download: a batch that went through the fused
+        # loop's lane compaction (megakernel.compact) keeps its alive
+        # frontier as a dense prefix — the bulky planes' dead tail rows
+        # are never read by the lift/harvest consumers, so only a lane
+        # bucket over the prefix ships. The prefix property is VERIFIED
+        # from the already-fetched alive plane (an uncompacted batch —
+        # legacy slice loop, mesh — simply ships full-height).
+        alive = planes["alive"]
+        n_alive = int(alive.sum())
+        if n_alive < L and not alive[n_alive:].any():
+            lb = _lane_bucket(n_alive, L)
+            if lb < L:
+                l_used = lb
     big_shapes = []
     for f in _BIG_DOWN:
         dev = getattr(st, f)
         shape = tuple(dev.shape)
         if f in _TAPE_PLANES:
             shape = (shape[0], _tape_cols(f, t_used)) + shape[2:]
+        if l_used is not None:
+            shape = (l_used,) + shape[1:]
         big_shapes.append((f, shape, np.dtype(dev.dtype)))
     planes.update(
         _unpack_host(
-            np.asarray(_flatten_device(st, _BIG_DOWN, t_used)), big_shapes
+            np.asarray(_flatten_device(st, _BIG_DOWN, t_used, l_used)),
+            big_shapes,
         )
     )
     # pad sliced tape planes back to capacity (rows at or past tape_len
@@ -316,19 +345,29 @@ def batch_to_host(st: StateBatch) -> StateBatch:
             )
             full[:, : planes[f].shape[1]] = planes[f]
             planes[f] = full
+    # pad lane-sliced planes back to full height (dead-suffix lanes are
+    # equivalent to zeros for every host consumer)
+    if l_used is not None:
+        for f in _BIG_DOWN:
+            if planes[f].shape[0] != L:
+                full = np.zeros((L,) + planes[f].shape[1:], planes[f].dtype)
+                full[: planes[f].shape[0]] = planes[f]
+                planes[f] = full
     for name in _SKIP_DOWN:
         dev = getattr(st, name)
         planes[name] = np.zeros(dev.shape, dev.dtype)
     return StateBatch(**planes)
 
 
-@partial(jax.jit, static_argnames=("fields", "t_used"))
-def _flatten_device(st: StateBatch, fields, t_used=None):
+@partial(jax.jit, static_argnames=("fields", "t_used", "l_used"))
+def _flatten_device(st: StateBatch, fields, t_used=None, l_used=None):
     parts = []
     for name in fields:
         x = getattr(st, name)
         if t_used is not None and name in _TAPE_PLANES:
             x = x[:, : _tape_cols(name, t_used)]
+        if l_used is not None:
+            x = x[:l_used]
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.uint8)
         if x.dtype.itemsize > 1:

@@ -139,6 +139,7 @@ def _solver_samples():
             (),
             snap["static_unsat_seeds"],
         ),
+        ("myth_solver_round_batches_total", (), snap["round_batches"]),
         ("myth_solver_pending_total", (), snap["pending"]),
         ("myth_solver_time_s", (), snap["time_s"]),
     ]

@@ -31,6 +31,28 @@ log = logging.getLogger(__name__)
 ENV_EVERY = "MYTHRIL_TPU_CKPT_EVERY"
 DEFAULT_EVERY = 1
 
+# job_id -> owning journal, registered by install() and dropped by
+# clear(): the route for device-round CREDITS from the backend's fused
+# super-rounds (see credit_rounds). Module-level because exec_batch
+# only knows the job id, not which service's journal owns it.
+_CREDIT_SINKS: Dict[str, "CheckpointJournal"] = {}
+_SINKS_LOCK = threading.Lock()
+
+
+def credit_rounds(job_id: str, k: int) -> None:
+    """Credit ``k`` retired device rounds to ``job_id``'s journal.
+
+    A K-fused super-round retires K device rounds inside one guarded
+    call; without credits the journal — whose cadence counts journal-
+    hook firings — would silently stretch its interval by K. Once a
+    job's credits cover one cadence period, the next ``stop_sym_trans``
+    snapshots regardless of the modulus. No-op for jobs without an
+    installed journal (single-tenant CLI runs)."""
+    with _SINKS_LOCK:
+        journal = _CREDIT_SINKS.get(job_id)
+    if journal is not None:
+        journal._credit(job_id, k)
+
 
 class FrontierCheckpoint:
     """One journaled frontier: the open-state set after ``rounds_done``
@@ -79,8 +101,15 @@ class CheckpointJournal:
         self.every = every
         self._lock = threading.Lock()
         self._latest: Dict[str, FrontierCheckpoint] = {}
+        self._credits: Dict[str, int] = {}
         self.overhead_s = 0.0
         self.snapshots = 0
+
+    def _credit(self, job_id: str, k: int) -> None:
+        with self._lock:
+            self._credits[job_id] = self._credits.get(job_id, 0) + max(
+                0, int(k)
+            )
 
     def install(self, job_id: str, laser, total_rounds: int,
                 rounds_offset: int = 0) -> None:
@@ -93,6 +122,8 @@ class CheckpointJournal:
         and a failure after it has nothing left to resume."""
         if self.every <= 0:
             return
+        with _SINKS_LOCK:
+            _CREDIT_SINKS[job_id] = self
         state = {"completed": rounds_offset}
 
         def journal_hook():
@@ -100,7 +131,12 @@ class CheckpointJournal:
             done = state["completed"]
             if done >= total_rounds:
                 return
-            if (done - rounds_offset) % self.every:
+            with self._lock:
+                credits = self._credits.get(job_id, 0)
+            # cadence: the round modulus, OR enough device-round credits
+            # (fused super-rounds, credit_rounds) to cover one period —
+            # a K=32 fused round must not skip K-1 intervals silently
+            if (done - rounds_offset) % self.every and credits < self.every:
                 return
             address = getattr(laser, "executed_transaction_address", None)
             if address is None:
@@ -121,6 +157,7 @@ class CheckpointJournal:
                 self._latest[job_id] = ckpt
                 self.snapshots += 1
                 self.overhead_s += dt
+                self._credits[job_id] = 0
             _cat.CHECKPOINTS_TOTAL.inc()
             _cat.CHECKPOINT_OVERHEAD_S.inc(dt)
             obs.TRACER.mark(
@@ -135,8 +172,12 @@ class CheckpointJournal:
             return self._latest.get(job_id)
 
     def clear(self, job_id: str) -> None:
+        with _SINKS_LOCK:
+            if _CREDIT_SINKS.get(job_id) is self:
+                _CREDIT_SINKS.pop(job_id, None)
         with self._lock:
             self._latest.pop(job_id, None)
+            self._credits.pop(job_id, None)
 
     def stats(self) -> Dict[str, float]:
         with self._lock:

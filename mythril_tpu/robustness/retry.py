@@ -42,6 +42,15 @@ BACKOFF_MAX_S = 2.0
 BREAKER_THRESHOLD = 3
 BREAKER_COOLDOWN_S = 60.0
 
+# round watchdog: seconds of stepping-loop wall budget PER DEVICE ROUND.
+# One guarded call used to be one device round; with the fused megakernel
+# it is a K-round super-round, so the budget scales by the planned K —
+# a K=32 super-round is 32 rounds of legitimate work, not a wedge. The
+# clamp is cooperative (backend._run_device checks its deadline between
+# dispatches and RUNNING lanes simply lift and continue), so expiry
+# degrades throughput, never correctness.
+ROUND_WATCHDOG_S = 30.0
+
 
 class DeviceRoundError(RuntimeError):
     """A device round failed every attempt; the caller must continue the
@@ -150,7 +159,7 @@ class RoundCounters:
 
 
 def run_round_guarded(bridge, cfg, *, want_stats=False, deadline=None,
-                      counters=None, sleep=time.sleep):
+                      counters=None, sleep=time.sleep, fused_k=None):
     """One watchdogged device round: upload + step loop + download.
 
     Retries the whole chain with bounded exponential backoff
@@ -161,9 +170,18 @@ def run_round_guarded(bridge, cfg, *, want_stats=False, deadline=None,
     time is host transport, kept out of the device section as before).
     Exhaustion records a breaker failure and raises
     :class:`DeviceRoundError`.
+
+    ``fused_k`` is the super-round depth the stepping loop plans to run
+    (default: asked from the backend). The watchdog deadline scales by
+    it — ``ROUND_WATCHDOG_S * fused_k`` — and is folded into the
+    caller's ``deadline``, so a K-fused round gets K rounds' budget
+    instead of tripping the single-round clamp.
     """
     from mythril_tpu.laser.tpu import backend, transfer
 
+    if fused_k is None:
+        fused_k = backend.planned_fused_k()
+    watchdog_s = ROUND_WATCHDOG_S * max(1, int(fused_k))
     attempts = 1 + DEVICE_MAX_RETRIES
     delay = BACKOFF_BASE_S
     last = None
@@ -180,10 +198,13 @@ def run_round_guarded(bridge, cfg, *, want_stats=False, deadline=None,
             with obs.phase("transfer_up"):
                 cb, st = bridge.finish()
             t0 = time.time()
+            round_deadline = t0 + watchdog_s
+            if deadline is not None:
+                round_deadline = min(deadline, round_deadline)
             with obs.phase("device_round"):
                 out, op_hist = backend._run_device(
                     cb, st, cfg, want_stats=want_stats,
-                    deadline=deadline, bridge=bridge,
+                    deadline=round_deadline, bridge=bridge,
                 )
             device_wall = time.time() - t0
             with obs.phase("transfer_down"):

@@ -87,4 +87,13 @@ python -m pytest tests/robustness/ \
     -q -p no:cacheprovider \
     -k "not matrix and not slow"
 
+echo "== megakernel smoke =="
+# fused device-loop smoke: one tiny-lane compile of the megakernel plus
+# the compaction/prune unit checks (CPU jit, seconds). The fused-vs-
+# legacy equivalence and the full S2 compaction property test run with
+# the full suite; -k trims to the fast half.
+python -m pytest tests/laser/test_megakernel.py \
+    -q -p no:cacheprovider \
+    -k "smoke or compact_basic or prune_mask"
+
 echo "ALL CHECKS PASSED"

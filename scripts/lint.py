@@ -27,6 +27,11 @@ standard library can check reliably:
     fault-injection seams — see docs/ROBUSTNESS.md — a catch-all
     handler must reference the bound exception or re-raise, so failures
     are classified rather than silenced; noqa exempts)
+  - no host escapes in the fused device-loop body files (``.item()``,
+    JAX host callbacks, in-function ``np.*``/``time.*``/``print``,
+    ``bool()``/``float()`` coercions in engine.py/megakernel.py — a
+    host sync pinned into the megakernel defeats device residency, see
+    docs/DEVICE_LOOP.md; noqa exempts host-side helpers)
   - no tabs in indentation, no trailing whitespace, newline at EOF
 
 Run via scripts/check.sh. Exit 0 = clean.
@@ -552,6 +557,94 @@ def metric_names(tree: ast.AST, source: str, rel: str):
     return sorted(set(out))
 
 
+# Files whose function bodies run INSIDE the fused device loop
+# (megakernel.py while_loop body -> engine.step). A host escape here —
+# a callback, a numpy coercion, ``.item()``/``bool()`` on a tracer —
+# either breaks the trace or, worse, silently pins a host sync into
+# what must stay a device-resident megakernel (docs/DEVICE_LOOP.md).
+# JAX itself errors on `if tracer:` at trace time; this rule catches
+# the escapes that would NOT error. Module-level numpy (the opcode
+# tables engine.py bakes into constants) is allowed; host-side decode
+# helpers in the same file take a noqa.
+_DEVICE_PURE_FILES = {
+    "mythril_tpu/laser/tpu/engine.py",
+    "mythril_tpu/laser/tpu/megakernel.py",
+}
+
+_HOST_CALLBACK_NAMES = {
+    "io_callback",
+    "pure_callback",
+    "host_callback",
+    "call_tf",
+    "debug_callback",
+}
+
+_HOST_COERCIONS = {"bool", "float"}  # on a traced value: host sync/error
+
+
+def device_loop_purity(tree: ast.AST, source: str, rel: str):
+    """(lineno, desc) pairs for host-escape primitives inside the fused
+    device-loop body files: JAX host callbacks, ``.item()`` calls,
+    ``np.*``/``time.*``/``print`` calls inside function bodies, and
+    ``bool()``/``float()`` coercions. noqa exempts a deliberately
+    host-side helper (e.g. a result decoder living next to its kernel).
+    """
+    if rel not in _DEVICE_PURE_FILES:
+        return []
+    lines = source.splitlines()
+    out = []
+
+    def scan(node):
+        problems = []
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or _noqa(lines, sub.lineno):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr == "item":
+                    problems.append((sub.lineno, "'.item()' host sync"))
+                elif fn.attr in _HOST_CALLBACK_NAMES:
+                    problems.append(
+                        (sub.lineno, f"host callback '{fn.attr}'")
+                    )
+                else:
+                    base = fn
+                    while isinstance(base, ast.Attribute):
+                        base = base.value
+                    if isinstance(base, ast.Name) and base.id in (
+                        "np",
+                        "numpy",
+                        "time",
+                    ):
+                        problems.append((
+                            sub.lineno,
+                            f"host-side '{base.id}.{fn.attr}()' call",
+                        ))
+            elif isinstance(fn, ast.Name):
+                if fn.id in _HOST_CALLBACK_NAMES:
+                    problems.append(
+                        (sub.lineno, f"host callback '{fn.id}'")
+                    )
+                elif fn.id == "print":
+                    problems.append((sub.lineno, "'print()' call"))
+                elif fn.id in _HOST_COERCIONS and sub.args:
+                    problems.append(
+                        (sub.lineno, f"'{fn.id}()' coercion of a value")
+                    )
+        return problems
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for lineno, what in scan(node):
+                out.append((
+                    lineno,
+                    f"device_loop_purity: {what} inside a fused-loop "
+                    "body file (host escapes pin a sync into the "
+                    "megakernel; noqa for host-side helpers)",
+                ))
+    return sorted(set(out))
+
+
 def _swc_registry():
     """(constant name -> id string, set of valid SWC id strings) from
     analysis/swc_data.py (module-level string assignments + the
@@ -706,6 +799,8 @@ def main() -> int:
         for lineno, desc in seam_exceptions(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for lineno, desc in metric_names(tree, source, str(rel)):
+            problems.append(f"{rel}:{lineno}: {desc}")
+        for lineno, desc in device_loop_purity(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")

@@ -32,6 +32,15 @@ os.environ.setdefault(
     ),
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# NOTE: jax is already imported by force_cpu above, so these env vars
+# only reach service SUBPROCESSES (which import jax fresh) — the main
+# pytest process compiles uncached. That is deliberate: flipping the
+# live config here (jax.config.update via ensure_compile_cache) was
+# tried and produced MISCOMPILES on round-trip — an XLA:CPU executable
+# deserialized from this cache returned different results than the
+# fresh compile that wrote it (observed: fused-vs-legacy plane
+# divergence, a phantom surviving lane in static-prune). Keep the main
+# process on fresh compiles.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,209 @@
+"""Fused device round loop (laser/tpu/megakernel.py): smoke, the S2
+compaction-equals-host-repack property, REVERT pruning with counter
+fold-in, and fused-vs-legacy stepping equivalence.
+
+The compaction oracle is pure numpy: a stable host repack that packs
+the surviving lanes (in their original relative order) ahead of the
+dead ones. Every StateBatch plane is lane-major, so the oracle applies
+one gather to each plane independently — if the device compaction ever
+diverges on ANY plane (job_id, seed_id, the symbolic tape chains, ...)
+the field-by-field comparison names it.
+"""
+
+import numpy as np
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu import megakernel
+from mythril_tpu.laser.tpu.batch import (
+    RETURNED,
+    RUNNING,
+    TRAP,
+    BatchConfig,
+    batch_shapes,
+    default_env,
+    empty_batch,
+    load_lane,
+    make_code_bank,
+)
+from mythril_tpu.laser.tpu.engine import run
+
+CFG = BatchConfig(lanes=4, stack_slots=32, memory_bytes=1024,
+                  calldata_bytes=128, storage_slots=8, code_len=512)
+
+ARITH_SRC = """
+    PUSH1 0x04
+    PUSH1 0x03
+    ADD
+    PUSH1 0x05
+    MUL
+    PUSH1 0x00
+    MSTORE
+    PUSH1 0x20
+    PUSH1 0x00
+    RETURN
+"""
+
+REVERT_SRC = """
+    PUSH1 0x00
+    PUSH1 0x00
+    REVERT
+"""
+
+
+def _fresh(src, lanes=1, host_ops=(), prune_revert=False, cfg=CFG):
+    code = assemble(src)
+    cb = make_code_bank(
+        [code], cfg.code_len, host_ops=host_ops, prune_revert=prune_revert
+    )
+    st = empty_batch(cfg)
+    for lane in range(lanes):
+        st = load_lane(st, lane, calldata=b"", gas=10_000_000)
+    return cb, st
+
+
+def test_smoke_fused_runs_to_quiescence():
+    cb, st = _fresh(ARITH_SRC, lanes=2)
+    out = megakernel.run_fused(
+        cb, default_env(), st, max_rounds=4, steps_per_round=64
+    )
+    stats = megakernel.decode_info(out.info)
+    status = np.asarray(out.st.status)
+    assert stats.rounds >= 1
+    assert stats.n_running == 0
+    assert stats.n_alive == 2
+    assert status[0] == RETURNED and status[1] == RETURNED
+    # the arithmetic program never forks or dies: no prune activity
+    assert stats.pruned_lanes == 0
+    assert not np.asarray(out.pruned_visited).any()
+
+
+def test_smoke_fused_respects_max_rounds():
+    # an infinite loop can only retire max_rounds * steps_per_round
+    # steps (steps_per_round=64 deliberately matches the other tests
+    # here: it is a static argnum, so a distinct value is a distinct
+    # ~20s XLA compile)
+    cb, st = _fresh("here:\nJUMPDEST\nPUSH1 :here\nJUMP", lanes=1)
+    out = megakernel.run_fused(
+        cb, default_env(), st, max_rounds=3, steps_per_round=64
+    )
+    stats = megakernel.decode_info(out.info)
+    assert stats.rounds == 3
+    assert stats.n_running == 1
+    assert int(np.asarray(out.st.steps)[0]) == 3 * 64
+
+
+def _random_plane(rng, shape, dtype):
+    if dtype == np.bool_:
+        return rng.random(shape) < 0.5
+    info = np.iinfo(dtype)
+    return rng.integers(
+        info.min, int(info.max) + 1, size=shape, dtype=dtype
+    )
+
+
+def test_compact_basic_dead_lanes_sink():
+    cfg = CFG
+    st = empty_batch(cfg)
+    for lane in range(4):
+        st = load_lane(st, lane, calldata=bytes([lane]), gas=100 + lane)
+    alive = np.array([False, True, False, True])
+    st = st._replace(alive=np.asarray(alive))
+    out = megakernel.compact_impl(st)
+    got_alive = np.asarray(out.alive)
+    # survivors form a dense prefix, in their original relative order
+    assert got_alive.tolist() == [True, True, False, False]
+    assert np.asarray(out.gas_left)[:2].tolist() == [101, 103]
+    assert np.asarray(out.calldata)[:2, 0].tolist() == [1, 3]
+
+
+def test_compact_property_equals_host_repack():
+    """S2: device lane compaction == stable host pack of the survivors,
+    on every SoA plane, for random batch contents and random dead masks.
+    """
+    cfg = BatchConfig(lanes=16, stack_slots=8, memory_bytes=64,
+                      calldata_bytes=32, storage_slots=4, code_len=64)
+    shapes = batch_shapes(cfg)
+    fields = list(type(empty_batch(cfg))._fields)
+    assert set(fields) == set(shapes)  # oracle covers every plane
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        planes = {
+            name: _random_plane(rng, shape, dtype)
+            for name, (shape, dtype) in shapes.items()
+        }
+        # random dead mask, including the all-dead and all-alive edges
+        if seed == 0:
+            alive = np.zeros(cfg.lanes, dtype=np.bool_)
+        elif seed == 1:
+            alive = np.ones(cfg.lanes, dtype=np.bool_)
+        else:
+            alive = rng.random(cfg.lanes) < 0.5
+        planes["alive"] = alive
+        st = empty_batch(cfg)._replace(
+            **{k: np.asarray(v) for k, v in planes.items()}
+        )
+        out = megakernel.compact_impl(st)
+        # host oracle: survivors first (original order), dead after
+        order = np.concatenate(
+            [np.nonzero(alive)[0], np.nonzero(~alive)[0]]
+        )
+        for name in fields:
+            want = planes[name][order]
+            got = np.asarray(getattr(out, name))
+            assert np.array_equal(got, want), (
+                f"plane {name!r} diverged from host repack "
+                f"(seed={seed}, alive={alive.astype(int).tolist()})"
+            )
+
+
+def test_prune_kills_outermost_revert_and_folds_counters():
+    # REVERT is host-routed (the integrated pipeline's _ALWAYS_HOST), so
+    # the lane freezes at TRAP with trap_op 0xFD; with prune_revert
+    # armed the fused loop must kill it on device and fold its counters
+    # into the info vector instead of leaving them for a host lift
+    cb, st = _fresh(
+        REVERT_SRC, lanes=2, host_ops=(0xFD,), prune_revert=True
+    )
+    # lane 1 is NOT an outermost frame: pruning it would lose an
+    # observable inner-call revert, so it must survive as a TRAP
+    outermost = np.asarray(st.outermost).copy()
+    outermost[1] = False
+    st = st._replace(outermost=np.asarray(outermost))
+    out = megakernel.run_fused(
+        cb, default_env(), st, max_rounds=4, steps_per_round=64
+    )
+    stats = megakernel.decode_info(out.info)
+    assert stats.pruned_lanes == 1
+    assert stats.pruned_steps > 0
+    alive = np.asarray(out.st.alive)
+    status = np.asarray(out.st.status)
+    assert alive.sum() == 1
+    # the survivor (compacted to lane 0) is the non-outermost TRAP lane
+    assert alive[0] and status[0] == TRAP
+    # the pruned lane's coverage was folded into pruned_visited ...
+    pv = np.asarray(out.pruned_visited)
+    assert pv[0].any()
+    # ... and its counter planes were zeroed so the host's whole-batch
+    # sums cannot double-count against the accumulators
+    assert int(np.asarray(out.st.steps)[alive.argmin():].sum()) == 0
+
+
+def test_fused_matches_legacy_slice_loop():
+    cb, st = _fresh(ARITH_SRC, lanes=3)
+    legacy = run(cb, default_env(), st, max_steps=2048)
+    cb2, st2 = _fresh(ARITH_SRC, lanes=3)
+    fused = megakernel.run_fused(
+        cb2, default_env(), st2, max_rounds=8, steps_per_round=512
+    ).st
+    # no lane died, so compaction is the identity permutation and the
+    # two paths must agree plane-for-plane on the machine state
+    for name in ("alive", "status", "pc", "sp", "steps", "stack",
+                 "memory", "ret_off", "ret_len", "visited"):
+        assert np.array_equal(
+            np.asarray(getattr(legacy, name)),
+            np.asarray(getattr(fused, name)),
+        ), f"fused loop diverged from legacy run on plane {name!r}"
+    assert int(np.asarray(fused.status)[0]) == RETURNED
+    assert not np.asarray(
+        fused.alive & (fused.status == RUNNING)
+    ).any()

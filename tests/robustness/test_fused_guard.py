@@ -1,0 +1,208 @@
+"""S1 guards for the fused device loop: the round watchdog scales with
+the planned super-round depth K (a K=32 fused round is K rounds of
+legitimate work, not a wedge), device_round fault injection still
+retries cleanly THROUGH the real fused path, and checkpoint credits
+keep the journal cadence honest when one guarded call retires K rounds.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from mythril_tpu.laser.tpu import backend
+from mythril_tpu.laser.tpu.batch import (
+    RETURNED,
+    BatchConfig,
+    empty_batch,
+    load_lane,
+    make_code_bank,
+)
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.robustness import faults, retry
+from mythril_tpu.robustness.checkpoint import CheckpointJournal, credit_rounds
+
+CFG = BatchConfig(lanes=4, stack_slots=32, memory_bytes=1024,
+                  calldata_bytes=128, storage_slots=8, code_len=512)
+
+
+class StubBridge:
+    def __init__(self, cb="cb", st="st"):
+        self._payload = (cb, st)
+        self.finishes = 0
+
+    def finish(self):
+        self.finishes += 1
+        return self._payload
+
+
+def no_sleep(_):
+    pass
+
+
+@pytest.fixture
+def capture_deadline(monkeypatch):
+    seen = {}
+
+    def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
+        seen["deadline"] = deadline
+        seen["at"] = time.time()
+        return "dev-out", None
+
+    monkeypatch.setattr(backend, "_run_device", _run_device)
+    from mythril_tpu.laser.tpu import transfer
+
+    monkeypatch.setattr(transfer, "batch_to_host", lambda out: out)
+    return seen
+
+
+def test_watchdog_scales_with_fused_k(capture_deadline):
+    retry.run_round_guarded(
+        StubBridge(), cfg=None, counters=retry.RoundCounters(),
+        sleep=no_sleep, fused_k=32,
+    )
+    budget = capture_deadline["deadline"] - capture_deadline["at"]
+    # 32 rounds' budget, not one round's: the K=32 super-round must not
+    # trip the single-round watchdog clamp
+    assert budget == pytest.approx(32 * retry.ROUND_WATCHDOG_S, rel=0.05)
+
+
+def test_watchdog_unfused_keeps_single_round_budget(capture_deadline):
+    retry.run_round_guarded(
+        StubBridge(), cfg=None, counters=retry.RoundCounters(),
+        sleep=no_sleep, fused_k=1,
+    )
+    budget = capture_deadline["deadline"] - capture_deadline["at"]
+    assert budget == pytest.approx(retry.ROUND_WATCHDOG_S, rel=0.05)
+
+
+def test_caller_deadline_still_clamps_a_fused_round(capture_deadline):
+    # --execution-timeout stays authoritative: the scaled watchdog only
+    # ever RAISES the budget relative to one round, never past the
+    # caller's own deadline
+    hard = time.time() + 5.0
+    retry.run_round_guarded(
+        StubBridge(), cfg=None, counters=retry.RoundCounters(),
+        sleep=no_sleep, fused_k=32, deadline=hard,
+    )
+    assert capture_deadline["deadline"] == hard
+
+
+def test_planned_fused_k_pins_and_disables(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_FUSED", "on")
+    monkeypatch.setenv("MYTHRIL_TPU_FUSED_K", "32")
+    assert backend.planned_fused_k() == 32
+    monkeypatch.setenv("MYTHRIL_TPU_FUSED", "off")
+    assert backend.planned_fused_k() == 1
+
+
+def test_half_open_breaker_falls_back_to_sync_loop(monkeypatch):
+    # the degrade ladder (docs/DEVICE_LOOP.md): a half-open breaker
+    # probes the device through the simpler synchronous slice loop
+    monkeypatch.delenv("MYTHRIL_TPU_FUSED", raising=False)
+    breaker = retry.CircuitBreaker(threshold=1, cooldown_s=0.0)
+    monkeypatch.setattr(retry, "BREAKER", breaker)
+    assert backend._fused_enabled()
+    breaker.record_failure()
+    assert breaker.state() == "half-open"
+    assert not backend._fused_enabled()
+    breaker.record_success()
+    assert backend._fused_enabled()
+
+
+def test_device_round_fault_retries_through_real_fused_path(monkeypatch):
+    """The PR 5 fault matrix contract at the device_round seam survives
+    fusion: one injected fault inside a fused super-round costs one
+    retry, then the REAL megakernel path runs the batch to quiescence.
+    """
+    monkeypatch.setenv("MYTHRIL_TPU_FUSED", "on")
+    monkeypatch.setenv("MYTHRIL_TPU_FUSED_K", "4")
+    code = assemble(
+        "PUSH1 0x2a\nPUSH1 0x00\nMSTORE\nPUSH1 0x20\nPUSH1 0x00\nRETURN"
+    )
+    cb = make_code_bank([code], CFG.code_len)
+    st = empty_batch(CFG)
+    st = load_lane(st, 0, calldata=b"", gas=1_000_000)
+    bridge = StubBridge(cb, st)
+    faults.configure("device_round=error:n=1")
+    counters = retry.RoundCounters()
+    out, _, wall = retry.run_round_guarded(
+        bridge, cfg=CFG, counters=counters, sleep=no_sleep
+    )
+    assert counters.device_retries == 1
+    # the seam fault fires before the upload, so only the clean attempt
+    # reached bridge.finish()
+    assert bridge.finishes == 1
+    assert wall >= 0.0
+    assert int(np.asarray(out.status)[0]) == RETURNED
+    # the fused stats rode back on the bridge for exec_batch to merge
+    info = bridge.fused_round_info
+    assert info["rounds"] >= 1 and info["syncs"] >= 1
+    assert retry.BREAKER.state() == "closed"
+
+
+# -- checkpoint credits ------------------------------------------------------
+
+
+class FakeLaser:
+    def __init__(self, address=0x1234):
+        self.executed_transaction_address = address
+        self.open_states = ["frontier"]
+        self.hooks = []
+
+    def register_laser_hooks(self, kind, hook):
+        assert kind == "stop_sym_trans"
+        self.hooks.append(hook)
+
+    def end_round(self):
+        for hook in self.hooks:
+            hook()
+
+
+def test_fused_rounds_credit_the_journal_cadence():
+    journal = CheckpointJournal(every=4)
+    laser = FakeLaser()
+    journal.install("j1", laser, total_rounds=100)
+    try:
+        # plain cadence: rounds 1..3 are off-modulus, no snapshot
+        laser.end_round()
+        assert journal.latest("j1") is None
+        # a K=32 fused super-round credits 32 device rounds: the next
+        # transaction-round boundary snapshots even though 2 % 4 != 0 —
+        # the journal must not silently stretch its interval by K
+        credit_rounds("j1", 32)
+        laser.end_round()
+        ckpt = journal.latest("j1")
+        assert ckpt is not None and ckpt.rounds_done == 2
+        # the snapshot consumed the credits: the following off-modulus
+        # round does not snapshot again
+        laser.end_round()
+        assert journal.latest("j1").rounds_done == 2
+    finally:
+        journal.clear("j1")
+
+
+def test_credits_below_one_period_do_not_fire_early():
+    journal = CheckpointJournal(every=8)
+    laser = FakeLaser()
+    journal.install("j2", laser, total_rounds=100)
+    try:
+        credit_rounds("j2", 3)  # less than one cadence period
+        laser.end_round()
+        assert journal.latest("j2") is None
+    finally:
+        journal.clear("j2")
+
+
+def test_credit_for_unregistered_job_is_a_noop():
+    credit_rounds("no-such-job", 32)  # must not raise
+
+
+def test_clear_drops_the_credit_sink():
+    journal = CheckpointJournal(every=4)
+    laser = FakeLaser()
+    journal.install("j3", laser, total_rounds=100)
+    journal.clear("j3")
+    credit_rounds("j3", 32)  # routes nowhere
+    laser.end_round()
+    assert journal.latest("j3") is None
