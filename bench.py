@@ -287,10 +287,16 @@ def _ratio(num, den):
 
 def _solver_snapshot() -> dict:
     """Current process-global solver-cache counters (bench protocol:
-    solver_time_s / solver_cache_hit_rate / z3_fallback_inflight_p95)."""
+    solver_time_s / solver_cache_hit_rate / z3_fallback_inflight_p95),
+    plus the catalog's CNF blast-volume counters (the stage-3 rewrite
+    pass's acceptance denominator, docs/REWRITE_PASS.md)."""
     from mythril_tpu.laser.tpu import solver_cache
+    from mythril_tpu.obs import catalog as obs_catalog
 
-    return solver_cache.GLOBAL.snapshot()
+    snap = solver_cache.GLOBAL.snapshot()
+    snap["cnf_vars"] = obs_catalog.CNF_VARS_TOTAL.value()
+    snap["cnf_clauses"] = obs_catalog.CNF_CLAUSES_TOTAL.value()
+    return snap
 
 
 def _solver_delta(base: dict) -> dict:
@@ -299,6 +305,8 @@ def _solver_delta(base: dict) -> dict:
     now = _solver_snapshot()
     queries = now["queries"] - base["queries"]
     hits = now["hits"] - base["hits"]
+    bits_before = now["rewrite_bits_before"] - base["rewrite_bits_before"]
+    bits_after = now["rewrite_bits_after"] - base["rewrite_bits_after"]
     return {
         "solver_time_s": round(now["time_s"] - base["time_s"], 4),
         "solver_cache_hit_rate": round(hits / queries, 4) if queries else 0.0,
@@ -307,6 +315,30 @@ def _solver_delta(base: dict) -> dict:
         "z3_fallback_inflight_p95": now["inflight_p95"],
         "static_unsat_seeds": now["static_unsat_seeds"]
         - base["static_unsat_seeds"],
+        # stage-3 rewrite pass (docs/REWRITE_PASS.md)
+        "rewrite_time_s": round(
+            now["rewrite_time_s"] - base["rewrite_time_s"], 4
+        ),
+        "constraints_discharged_static": now["rewrite_discharged"]
+        - base["rewrite_discharged"],
+        # bit-width-weighted DAG shrink: the CNF-variable proxy for
+        # what word-level rewriting removed before any blasting
+        "cnf_vars_saved_pct": (
+            round((bits_before - bits_after) / bits_before * 100.0, 2)
+            if bits_before
+            else 0.0
+        ),
+        "assumption_reuse_rate": (
+            round(
+                (now["assumption_reuse"] - base["assumption_reuse"]) / queries,
+                4,
+            )
+            if queries
+            else 0.0
+        ),
+        # real blast volume actually dispatched to the device kernel
+        "cnf_vars_blasted": int(now["cnf_vars"] - base["cnf_vars"]),
+        "cnf_clauses_blasted": int(now["cnf_clauses"] - base["cnf_clauses"]),
     }
 
 
@@ -344,6 +376,14 @@ def _emit(progress: dict) -> None:
                 "solver_cache_hit_rate": progress.get("solver_cache_hit_rate"),
                 "solver_cache_hits": progress.get("solver_cache_hits"),
                 "solver_queries": progress.get("solver_queries"),
+                "rewrite_time_s": progress.get("rewrite_time_s"),
+                "constraints_discharged_static": progress.get(
+                    "constraints_discharged_static"
+                ),
+                "cnf_vars_saved_pct": progress.get("cnf_vars_saved_pct"),
+                "assumption_reuse_rate": progress.get("assumption_reuse_rate"),
+                "cnf_vars_blasted": progress.get("cnf_vars_blasted"),
+                "cnf_clauses_blasted": progress.get("cnf_clauses_blasted"),
                 "z3_fallback_inflight_p95": progress.get(
                     "z3_fallback_inflight_p95"
                 ),
@@ -571,6 +611,114 @@ def _service_bench() -> int:
     return 0
 
 
+def _rewrite_ab_bench() -> int:
+    """``bench.py --rewrite-ab``: the stage-3 rewrite pass's acceptance
+    run (docs/REWRITE_PASS.md). The becstress steady-state protocol
+    twice through the identical tpu-batch pipeline — a
+    ``MYTHRIL_TPU_REWRITE=0`` control arm, then the treatment arm — with
+    the PR 9 span tracer live in both, so the ``solve``-phase shrink is
+    visible in the exported Chrome traces. Emits
+    ``BENCH_REWRITE_AB.json`` plus ``traces/rewrite_{control,
+    treatment}.trace.json`` and asserts the acceptance bar: >= 30% fewer
+    blasted CNF clauses, a hit rate no worse, and identical issue sets.
+    """
+    from mythril_tpu import obs
+    from mythril_tpu.disassembler.asm import assemble
+    from mythril_tpu.laser.tpu import solver_cache
+    from mythril_tpu.obs import catalog as obs_catalog
+
+    runtime = assemble(STRESS_SRC)
+    n = len(runtime)
+    creation_hex = (
+        assemble(
+            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\n"
+            f"PUSH2 {n}\nPUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + runtime.hex()
+    )
+    runtime_hex = runtime.hex()
+    root = os.path.dirname(os.path.abspath(__file__))
+    os.makedirs(os.path.join(root, "traces"), exist_ok=True)
+
+    def arm(label: str, rewrite_on: bool) -> dict:
+        os.environ["MYTHRIL_TPU_REWRITE"] = "1" if rewrite_on else "0"
+        # both arms start cold: memos, known-unsat facts, blast counters
+        # and the phase histogram are all process-global accumulators —
+        # and so is the incremental host core, whose clauses from a
+        # prior arm would exhaust the inline budget and skew verdicts
+        solver_cache.reset_for_tests()
+        solver_cache.get_core().reset()
+        obs_catalog.CNF_VARS_TOTAL.reset()
+        obs_catalog.CNF_CLAUSES_TOTAL.reset()
+        obs_catalog.ROUND_PHASE_S.reset()
+        base = _solver_snapshot()
+        obs.TRACER.enable()
+        try:
+            _phase(f"rewrite-ab: {label} arm (becstress, tx=2 budget=60)")
+            meter, swcs, _, tpu = _steady_analysis(
+                creation_hex, runtime_hex, "tpu-batch", 2, 60, "BECStress"
+            )
+        finally:
+            trace_path = os.path.join(
+                root, "traces", f"rewrite_{label}.trace.json"
+            )
+            obs.TRACER.export(trace_path)
+            obs.TRACER.disable()
+            obs.TRACER.clear()
+        out = {
+            "states_per_sec": round(meter.states_per_s, 1),
+            "swcs": swcs,
+            "trace": os.path.relpath(trace_path, root),
+        }
+        out.update(_solver_delta(base))
+        out.update(tpu)
+        hist = obs_catalog.ROUND_PHASE_S
+        solve_p50 = hist.percentile(50, "solve")
+        out["solve_phase_p50_ms"] = (
+            None if solve_p50 is None else round(solve_p50 * 1000.0, 3)
+        )
+        return out
+
+    # control FIRST: the treatment arm must not inherit (or donate)
+    # warm verdicts, and env-order effects stay symmetric either way
+    control = arm("control", rewrite_on=False)
+    treatment = arm("treatment", rewrite_on=True)
+    os.environ.pop("MYTHRIL_TPU_REWRITE", None)
+
+    reduction = _ratio(
+        control["cnf_clauses_blasted"] - treatment["cnf_clauses_blasted"],
+        control["cnf_clauses_blasted"],
+    )
+    result = {
+        "protocol": "rewrite-ab-v1",
+        "workload": "becstress tpu-batch tx=2 budget=60",
+        "control": control,
+        "treatment": treatment,
+        "cnf_clause_reduction_pct": (
+            None if reduction is None else round(reduction * 100.0, 1)
+        ),
+        "hit_rate_delta": round(
+            treatment["solver_cache_hit_rate"]
+            - control["solver_cache_hit_rate"],
+            4,
+        ),
+        "detection_parity": control["swcs"] == treatment["swcs"],
+        "accepted": (
+            reduction is not None
+            and reduction >= 0.30
+            and treatment["solver_cache_hit_rate"]
+            >= control["solver_cache_hit_rate"]
+            and control["swcs"] == treatment["swcs"]
+        ),
+    }
+    out_path = os.path.join(root, "BENCH_REWRITE_AB.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+    return 0 if result["accepted"] else 1
+
+
 def main() -> int:
     # persistent compile cache BEFORE jax initializes: the raw-kernel
     # phase below is the first (and most expensive) compile of the run
@@ -582,6 +730,8 @@ def main() -> int:
 
     if "--service" in sys.argv[1:]:
         return _service_bench()
+    if "--rewrite-ab" in sys.argv[1:]:
+        return _rewrite_ab_bench()
 
     from mythril_tpu.disassembler.asm import assemble
 

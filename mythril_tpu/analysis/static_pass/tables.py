@@ -41,7 +41,12 @@ INTEREST_INF = 1 << 30
 #   1 = PR 1 CFG/absint planes
 #   2 = taint/interval stage (taint_mask, jumpi_verdict, effect_flags,
 #       module_relevance, swc_mask)
-FACT_SCHEMA_VERSION = 2
+#   3 = stage-3 rewrite-pass plumbing: cond_intervals (MUST value
+#       bounds per JUMPI condition, the interval-discharge seeds).
+#       service/cache.py also folds this version into the solver-memo
+#       export keys, so alpha memos seeded from older fact planes miss
+#       instead of resurrecting (docs/REWRITE_PASS.md)
+FACT_SCHEMA_VERSION = 3
 
 # successor-table column cap: blocks with more resolved destinations
 # (huge dispatchers) overflow into succ_unknown, which stays sound
@@ -105,6 +110,10 @@ class StaticAnalysis(NamedTuple):
     effect_flags: np.ndarray  # u8[n_blocks]
     module_relevance: np.ndarray  # u32[code_len]
     swc_mask: np.ndarray  # u8[code_len]
+    # MUST bounds on JUMPI condition words (taint.py; consumed by the
+    # stage-3 rewrite pass as interval-discharge seeds): byte-pc ->
+    # (lo, hi) unsigned-256 inclusive; absent pc = no fact
+    cond_intervals: Dict[int, Tuple[int, int]]
 
     @property
     def n_blocks(self) -> int:
@@ -330,4 +339,5 @@ def build(code: bytes) -> StaticAnalysis:
         effect_flags=taint_facts.effect_flags,
         module_relevance=taint_facts.module_relevance,
         swc_mask=taint_facts.swc_mask,
+        cond_intervals=taint_facts.cond_intervals,
     )

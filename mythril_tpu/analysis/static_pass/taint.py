@@ -354,6 +354,15 @@ class TaintFacts(NamedTuple):
     module_relevance: np.ndarray  # u32[code_len]
     # SWC_MASK_* candidate bits per pc (device CodeBank plane)
     swc_mask: np.ndarray  # u8[code_len]
+    # MUST value bounds on the JUMPI condition word, keyed by JUMPI
+    # byte-pc — only sites where the converged interval is strictly
+    # narrower than [0, MASK] appear. The stage-3 rewrite pass
+    # (analysis/rewrite_pass) consumes these as discharge seeds: the
+    # bridge re-keys an entry by the lifted condition term's uid, and
+    # interval reasoning then proves/refutes path constraints without
+    # blasting (docs/REWRITE_PASS.md). A dict (not a dense plane):
+    # values are 256-bit ints numpy cannot hold losslessly.
+    cond_intervals: Dict[int, Tuple[int, int]]
 
 
 def compute(
@@ -388,6 +397,7 @@ def compute(
     blockenv_jumpi: set = set()
     literal_dest: set = set()  # JUMP/JUMPI pcs with a pure-PUSH dest
     safe_arith: set = set()  # provably non-wrapping ADD/SUB/MUL/EXP pcs
+    cond_intervals: Dict[int, Tuple[int, int]] = {}
 
     def visit(insn: Insn, pre: TaintState) -> None:
         spec = OPCODES.get(insn.op)
@@ -407,6 +417,8 @@ def compute(
                 jumpi_verdict[insn.pc] = 1  # must take
             elif cond[2] == 0:
                 jumpi_verdict[insn.pc] = 2  # must fall through
+            if (cond[1], cond[2]) != _FULL and cond[1] <= cond[2]:
+                cond_intervals[insn.pc] = (cond[1], cond[2])
         if op in (JUMP, JUMPI) and pre.slot(1)[0] == 0:
             literal_dest.add(insn.pc)
         if op in _ARITH_OPS and _arith_safe(op, pre.slot(1), pre.slot(2)):
@@ -428,6 +440,7 @@ def compute(
                 blockenv_jumpi.add(insn.pc)
             literal_dest.discard(insn.pc)
             safe_arith.discard(insn.pc)
+            cond_intervals.pop(insn.pc, None)
 
     # --- storage-effect summaries + call-before-write ordering --------
     has_window_call = np.zeros(n, bool)
@@ -542,4 +555,5 @@ def compute(
         effect_flags=effect_flags,
         module_relevance=module_relevance,
         swc_mask=swc_mask,
+        cond_intervals=cond_intervals,
     )

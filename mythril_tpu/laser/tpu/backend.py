@@ -34,7 +34,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from mythril_tpu.analysis import static_pass
+from mythril_tpu.analysis import rewrite_pass, static_pass
 from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.strategy import BasicSearchStrategy
 from mythril_tpu.laser.tpu.batch import (
@@ -975,9 +975,23 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
         # static must-UNSAT seeds: lanes the bridge flagged because their
         # retired path took a branch direction the interval analysis
         # proves impossible (tables.jumpi_verdict) are decided UNSAT
-        # without touching the memo or the device
+        # without touching the memo or the device; a lane whose path
+        # condition contains a term the rewrite stage already proved
+        # self-contradictory (assume.note_unsat_term) joins them —
+        # monotonicity makes any superset of an UNSAT term UNSAT
         static_unsat = [
             bool(getattr(s, "_static_unsat", False)) for s in undecided
+        ]
+        if rewrite_pass.known_unsat_count():
+            for i, cs in enumerate(sets):
+                if not static_unsat[i] and rewrite_pass.any_known_unsat(
+                    t.uid for t in cs
+                ):
+                    static_unsat[i] = True
+        # MUST value bounds on path condition words (bridge-attached from
+        # tables.cond_intervals): interval-discharge seeds for stage 3
+        interval_seeds = [
+            getattr(s, "_interval_seeds", None) for s in undecided
         ]
         verdicts = solver_cache.GLOBAL.decide_batch(
             sets,
@@ -985,6 +999,11 @@ def filter_feasible(states: List[GlobalState]) -> List[GlobalState]:
             flips=SOLVE_FLIPS,
             hints=hints,
             static_unsat=static_unsat if any(static_unsat) else None,
+            interval_seeds=(
+                interval_seeds
+                if any(m is not None for m in interval_seeds)
+                else None
+            ),
         )
         for s, verdict in zip(undecided, verdicts):
             s.world_state.constraints.seed_feasibility(

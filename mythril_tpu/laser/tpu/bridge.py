@@ -1090,8 +1090,18 @@ class DeviceBridge:
             analysis = getattr(gs.environment.code, "static_analysis", None)
             verdict_plane = getattr(analysis, "jumpi_verdict", None)
             if verdict_plane is not None:
+                # MUST value bounds on the same JUMPI condition words
+                # (tables.cond_intervals): any execution reaching the
+                # site keeps its condition inside the bound, and this
+                # lane's path passes through the site — so the bound is
+                # a sound fact about the lifted word in every model of
+                # the path condition. Keyed by the word's term uid; the
+                # rewrite pass uses them as interval-discharge seeds.
+                bounds_plane = getattr(analysis, "cond_intervals", None)
+                seeds: Dict[int, Tuple[int, int]] = {}
                 metas = np.asarray(st.path_meta)[lane]
                 path_signs = np.asarray(st.path_sign)[lane]
+                path_ids = np.asarray(st.path_id)[lane]
                 for j in range(plen):
                     site = symtape.unpack_meta(int(metas[j]))
                     if site is None or not 0 <= site[0] < analysis.code_len:
@@ -1103,6 +1113,15 @@ class DeviceBridge:
                     ):
                         gs._static_unsat = True
                         break
+                    if bounds_plane:
+                        bound = bounds_plane.get(site[0])
+                        node_id = int(path_ids[j])
+                        if bound is not None and 0 < node_id <= len(values):
+                            raw = getattr(values[node_id - 1], "raw", None)
+                            if raw is not None:
+                                seeds[raw.uid] = bound
+                if seeds and not gs._static_unsat:
+                    gs._interval_seeds = seeds
 
         self._replay_jumpi_sites(gs, st, lane, values)
         self._replay_segment_sites(gs, st, lane, values)

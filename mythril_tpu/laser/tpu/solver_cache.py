@@ -56,6 +56,7 @@ from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from mythril_tpu import obs
+from mythril_tpu.analysis import rewrite_pass as _rw
 from mythril_tpu.obs import catalog as _cat
 from mythril_tpu.robustness import faults
 from mythril_tpu.smt import terms
@@ -86,6 +87,18 @@ FALLBACK_QUEUE_MAX = 128
 ALPHA_NODE_CAP = 20_000
 
 _NAMED_OPS = ("var", "boolvar", "array_var", "apply")
+
+# ops whose semantics are argument-order-insensitive. The constructors
+# canonicalize SOME of these by uid (bool_eq, bool_iff) — but uids are
+# creation-order artifacts, so two alpha-equivalent sets built along
+# different histories (notably: rewritten forms, which mint constants
+# lazily) can store commutative args in different orders. The digest
+# treats their children as a multiset instead, so those sets still
+# share a key. Sound: permuting a commutative op's arguments is an
+# equivalence, so a digest collision by design is still alpha-equal.
+_COMMUTATIVE_OPS = frozenset(
+    ("eq", "iff", "band", "bor", "add", "mul", "and", "or", "xor")
+)
 
 _U64 = (1 << 64) - 1
 
@@ -139,8 +152,14 @@ def _blind_hash(root: Term) -> int:
             stack.extend((a, False) for a in t.args)
             continue
         h = _mix64(0x9E3779B97F4A7C15, hash(_op_tag(t)))
-        for a in t.args:
-            h = _mix64(h, local[a.uid])
+        if t.op in _COMMUTATIVE_OPS:
+            acc = 0
+            for a in t.args:
+                acc = (acc + _mix64(h, local[a.uid])) & _U64
+            h = _mix64(h, acc)
+        else:
+            for a in t.args:
+                h = _mix64(h, local[a.uid])
         local[t.uid] = h
         with _blind_lock:
             _blind_memo[t.uid] = h
@@ -215,8 +234,11 @@ def canonical_fingerprint(raw_terms: Sequence[Term]) -> Optional[bytes]:
         if not t.args:
             continue
         tag = hash(_op_tag(t))
+        commutative = t.op in _COMMUTATIVE_OPS
         for i, a in enumerate(t.args):
-            edge = _mix64(_mix64(base, tag), i)
+            # commutative parents give every child the same positional
+            # context: the stored arg order is a uid artifact
+            edge = _mix64(_mix64(base, tag), 0 if commutative else i)
             ctx[a.uid] = (ctx.get(a.uid, 0) + edge) & _U64
 
     # canonical index per named symbol, ordered by (signature, kind)
@@ -233,8 +255,12 @@ def canonical_fingerprint(raw_terms: Sequence[Term]) -> Optional[bytes]:
             h.update(repr((t.op, t.sort, t.size, index[t.uid]) + tuple(t.params[1:])).encode())
         else:
             h.update(repr(_op_tag(t)).encode())
-        for a in t.args:
-            h.update(digests[a.uid])
+        if t.op in _COMMUTATIVE_OPS:
+            for d in sorted(digests[a.uid] for a in t.args):
+                h.update(d)
+        else:
+            for a in t.args:
+                h.update(digests[a.uid])
         digests[t.uid] = h.digest()
 
     final = hashlib.blake2b(digest_size=16)
@@ -430,6 +456,11 @@ class FallbackPool:
             self.cache._add_time(time.monotonic() - t0)
             if code != UNKNOWN:
                 self.cache.record(job.raw_terms, code, key=job.key)
+                if code == UNSAT and _rw.enabled():
+                    # pool workers own their core: minimization is safe
+                    # off the round loop too, and a late-arriving short
+                    # core still prunes the descendant subtree
+                    self.cache._minimize_and_seed(job.raw_terms, self._core())
             self.cache._count("async_completed")
         finally:
             with self._lock:
@@ -503,6 +534,10 @@ _STAT_KEYS = (
     # super-round, so queries/round_batches exposes the dispatch
     # batching the fusion buys (ISSUE 14 solver seam)
     "round_batches",
+    # stage-3 rewrite pass (analysis/rewrite_pass, docs/REWRITE_PASS.md)
+    "rewrite_discharged",  # sets decided by rewrite/interval discharge
+    "assumption_reuse",  # sets answered SAT by ancestor-witness replay
+    "core_minimized",  # UNSAT verdicts whose prefix core was shortened
 )
 
 
@@ -529,6 +564,12 @@ class SolverCache:
         self._models: "OrderedDict[object, dict]" = OrderedDict()
         self._stats = {k: 0 for k in _STAT_KEYS}
         self._time_s = 0.0
+        # stage-3 rewrite accounting: wall time inside rewrite_set and
+        # the bit-width-weighted DAG sizes before/after (the CNF-variable
+        # proxy backing the bench's cnf_vars_saved_pct)
+        self._rewrite_time_s = 0.0
+        self._rw_bits_before = 0
+        self._rw_bits_after = 0
         self.pool: Optional[FallbackPool] = None
 
     # -- internals ------------------------------------------------------
@@ -670,6 +711,7 @@ class SolverCache:
         hints: Optional[Sequence] = None,
         host_fallback: bool = True,
         static_unsat: Optional[Sequence[bool]] = None,
+        interval_seeds: Optional[Sequence] = None,
     ) -> List[Optional[bool]]:
         """Decide a frontier of constraint sets: memo -> device batch ->
         inline quick host check -> async pool.
@@ -686,6 +728,21 @@ class SolverCache:
         recorded branch sign): they short-circuit to False without any
         solve, and the UNSAT is recorded so subsumption prunes the
         lane's descendants too.
+
+        ``interval_seeds[i]`` optionally maps term uids of set ``i`` to
+        MUST value intervals from the static fact planes (the bridge
+        attaches them from StaticAnalysis.cond_intervals). They feed the
+        stage-3 rewrite pass, which runs over every undecided set ahead
+        of the memo lookup (MYTHRIL_TPU_REWRITE=0 disables it): all
+        downstream keys — exact, alpha, subsumption — are computed over
+        the REWRITTEN forms, so canonicalization itself widens the memo's
+        reach. A set the rewrite/interval engine decides outright never
+        touches a solver; its single-term false core is recorded as a
+        maximal subsumption seed, and structurally-proven cores feed the
+        process-global known-unsat facts the bridge prunes on. Before a
+        solve, a cached ancestor witness is replayed against the
+        rewritten set (assume.try_witness): a concrete satisfying
+        assignment answers SAT with zero blasting.
 
         Host economics: when the device DID run, its residue goes to
         the ASYNC pool only (and only in service mode — see _pool_armed)
@@ -710,6 +767,13 @@ class SolverCache:
         digests: List[object] = [_NO_DIGEST] * n
         decided = [False] * n
         pending: List[int] = []
+        # work[i] is what actually gets keyed and solved: the rewritten
+        # residual when the stage-3 pass is on, the raw set otherwise.
+        # Rewriting is deterministic and memoized, so the same raw set
+        # re-rewrites to the identical (hash-consed) residual next round
+        # and the exact/alpha/subsumption keys stay stable.
+        work: List[Sequence[Term]] = list(sets)
+        rewriting = _rw.enabled()
         for i, cs in enumerate(sets):
             if static_unsat is not None and static_unsat[i]:
                 # statically proven contradiction: no lookup, no solve;
@@ -719,10 +783,66 @@ class SolverCache:
                 self._count("static_unsat_seeds")
                 self.record(cs, UNSAT)
                 continue
-            code, key, digest = self._lookup(cs)
+            if rewriting:
+                seeds_i = (
+                    interval_seeds[i] if interval_seeds is not None else None
+                )
+                rt0 = time.monotonic()
+                try:
+                    oc = _rw.rewrite_set(cs, seeds=seeds_i)
+                except Exception as e:  # pragma: no cover - defensive
+                    # the rewrite must never be the reason a set fails
+                    # to reach a solver: fall back to the raw terms
+                    log.warning("rewrite_set failed (raw terms used): %s", e)
+                    oc = None
+                with self._lock:
+                    self._rewrite_time_s += time.monotonic() - rt0
+                    if oc is not None:
+                        self._rw_bits_before += oc.bits_before
+                        self._rw_bits_after += oc.bits_after
+                if oc is not None:
+                    work[i] = list(oc.terms)
+                    if oc.verdict is False:
+                        verdicts[i] = False
+                        decided[i] = True
+                        self._count("rewrite_discharged")
+                        # the singleton core is a MAXIMAL subsumption
+                        # seed: any superset of {core} is UNSAT
+                        if oc.false_core is not None:
+                            self.record((oc.false_core,), UNSAT)
+                            if oc.core_is_structural:
+                                for t in (oc.false_core, oc.false_source):
+                                    if t is not None and t is not terms.FALSE:
+                                        _rw.note_unsat_term(t)
+                        continue
+                    if oc.verdict is True:
+                        verdicts[i] = True
+                        decided[i] = True
+                        self._count("rewrite_discharged")
+                        continue
+            code, key, digest = self._lookup(work[i])
             keys[i] = key
             digests[i] = digest
             if code is None:
+                if rewriting and hints is not None and hints[i]:
+                    # assumption reuse: the parent's cached witness is a
+                    # total assignment; if it concretely satisfies every
+                    # rewritten member, the child is SAT with that very
+                    # model — no blast, no solve
+                    model = self.model_hint(hints[i])
+                    if model is not None and _rw.try_witness(work[i], model):
+                        verdicts[i] = True
+                        decided[i] = True
+                        self._count("assumption_reuse")
+                        self.record(
+                            work[i],
+                            SAT,
+                            key=key,
+                            model=model,
+                            path_fp=hints[i][-1],
+                            digest=self._digest_or_none(digest),
+                        )
+                        continue
                 pending.append(i)
                 continue
             decided[i] = True
@@ -740,7 +860,7 @@ class SolverCache:
         # is not an exhausted budget)
         device_ok = True
         if use_device and pending:
-            sub = [sets[i] for i in pending]
+            sub = [work[i] for i in pending]
             warm = None
             if hints is not None:
                 warm = [self.model_hint(hints[i]) for i in pending]
@@ -778,7 +898,7 @@ class SolverCache:
                 if hints is not None and hints[i]:
                     fp = hints[i][-1]
                 self.record(
-                    sets[i],
+                    work[i],
                     SAT if v else UNSAT,
                     key=keys[i],
                     model=dev_models[j],
@@ -794,17 +914,17 @@ class SolverCache:
                 if use_device and device_ok:
                     # device residue: optimistic + async (see docstring)
                     self._count("unknown")
-                    self.record(sets[i], UNKNOWN, key=keys[i])
+                    self.record(work[i], UNKNOWN, key=keys[i])
                     if pool_armed:
                         self._get_pool().submit(
                             keys[i],
-                            sets[i],
+                            work[i],
                             deadline=deadline,
                             cancel_event=cancel_event,
                         )
                     continue
                 try:
-                    code = _host_check(sets[i], HOST_BUDGET_MS)
+                    code = _host_check(work[i], HOST_BUDGET_MS)
                 except Exception as e:
                     # faulted host check: stay optimistic (None verdict)
                     # and record NOTHING — no UNKNOWN memo may remember
@@ -815,23 +935,25 @@ class SolverCache:
                     verdicts[i] = True
                     self._count("host_decided")
                     self.record(
-                        sets[i], SAT, key=keys[i],
+                        work[i], SAT, key=keys[i],
                         digest=self._digest_or_none(digests[i]),
                     )
                 elif code == UNSAT:
                     verdicts[i] = False
                     self._count("host_decided")
                     self.record(
-                        sets[i], UNSAT, key=keys[i],
+                        work[i], UNSAT, key=keys[i],
                         digest=self._digest_or_none(digests[i]),
                     )
+                    if rewriting:
+                        self._minimize_and_seed(work[i], get_core())
                 else:
                     self._count("unknown")
-                    self.record(sets[i], UNKNOWN, key=keys[i])
+                    self.record(work[i], UNKNOWN, key=keys[i])
                     if pool_armed:
                         self._get_pool().submit(
                             keys[i],
-                            sets[i],
+                            work[i],
                             deadline=deadline,
                             cancel_event=cancel_event,
                         )
@@ -842,6 +964,27 @@ class SolverCache:
     @staticmethod
     def _digest_or_none(digest) -> Optional[bytes]:
         return None if digest is _NO_DIGEST else digest
+
+    def _minimize_and_seed(self, raw_terms: Sequence[Term], core) -> None:
+        """Shrink a fresh host UNSAT to its shortest prefix core and
+        feed it back: a shorter UNSAT set subsumes strictly more
+        supersets, and a single-term core (host-proven, hence holding
+        for every assignment) becomes a global known-unsat prune fact.
+        Best-effort: probes ride the warm core under assumptions and
+        any failure leaves the already-recorded full verdict intact."""
+        try:
+            prefix = _rw.minimize_unsat_prefix(core, raw_terms)
+        except Exception as e:  # pragma: no cover - defensive
+            log.warning("unsat core minimization failed: %s", e)
+            return
+        if prefix is None:
+            return
+        concrete = sum(1 for t in raw_terms if t is not terms.TRUE)
+        if len(prefix) < concrete:
+            self._count("core_minimized")
+            self.record(prefix, UNSAT)
+        if len(prefix) == 1:
+            _rw.note_unsat_term(prefix[0])
 
     def _pool_armed(self, cancel_event, deadline) -> bool:
         """The async pool engages only in SERVICE mode (a job context is
@@ -880,6 +1023,9 @@ class SolverCache:
         with self._lock:
             out = dict(self._stats)
             out["time_s"] = self._time_s
+            out["rewrite_time_s"] = self._rewrite_time_s
+            out["rewrite_bits_before"] = self._rw_bits_before
+            out["rewrite_bits_after"] = self._rw_bits_after
         pool = self.pool
         if pool is not None:
             out["inflight_p95"] = pool.inflight_p95()
@@ -905,6 +1051,9 @@ class SolverCache:
             self._models.clear()
             self._stats = {k: 0 for k in _STAT_KEYS}
             self._time_s = 0.0
+            self._rewrite_time_s = 0.0
+            self._rw_bits_before = 0
+            self._rw_bits_after = 0
             pool = self.pool
         if pool is not None:
             with pool._lock:
@@ -925,5 +1074,11 @@ def warm_device(constraint_sets, flips: Optional[int] = None) -> None:
 
 
 def reset_for_tests() -> None:
+    # NOTE: the process-global incremental host core is deliberately NOT
+    # reset here — conftest calls this per test, and re-blasting every
+    # test from a cold core multiplies suite wall time. Callers that
+    # need verdict determinism against a loaded core (the A/B bench
+    # arms, the rewrite-pass property tests) reset get_core() themselves.
     GLOBAL.reset()
     clear_job_context()
+    _rw.reset_for_tests()

@@ -37,6 +37,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from mythril_tpu import obs
+from mythril_tpu.obs import catalog as _cat
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.solver import pysat
 from mythril_tpu.smt.solver.bitblast import Blaster, BlastError
@@ -694,14 +695,23 @@ def check_batch(
         compiled = list(
             compile_cnf_batch(constraint_sets, max_vars, max_clauses)
         )
+    cnf_vars = 0
+    cnf_clauses = 0
     for i, inst in enumerate(compiled):
         if inst is None:
             continue
         if inst.trivial is not None:
             results[i] = inst.trivial
             continue
+        cnf_vars += int(inst.nvars)
+        cnf_clauses += int(inst.clause_arr.shape[0])
         live_idx.append(i)
         live_instances.append(inst)
+    # real blast volume: what the rewrite pass is measured against
+    # (MYTHRIL_TPU_REWRITE=0 control; docs/REWRITE_PASS.md)
+    if cnf_vars:
+        _cat.CNF_VARS_TOTAL.inc(cnf_vars)
+        _cat.CNF_CLAUSES_TOTAL.inc(cnf_clauses)
     if not live_instances:
         return (results, models_out) if return_models else results
 

@@ -46,6 +46,16 @@ SOLVER_BATCHES_TOTAL = REGISTRY.counter(
     "myth_solver_batches_total",
     "device feasibility kernel batches dispatched",
 )
+# real blast volume, accumulated at CNF-compile time (solver_jax
+# .check_batch): the denominator of the stage-3 rewrite pass's clause
+# reduction claim (docs/REWRITE_PASS.md) — compare against a
+# MYTHRIL_TPU_REWRITE=0 run of the same issue set
+CNF_VARS_TOTAL = REGISTRY.counter(
+    "myth_cnf_vars_total", "CNF variables blasted for device dispatch"
+)
+CNF_CLAUSES_TOTAL = REGISTRY.counter(
+    "myth_cnf_clauses_total", "CNF clauses blasted for device dispatch"
+)
 
 # -- robustness (robustness/retry.py, faults.py, checkpoint.py) --------
 
@@ -142,6 +152,29 @@ def _solver_samples():
         ("myth_solver_round_batches_total", (), snap["round_batches"]),
         ("myth_solver_pending_total", (), snap["pending"]),
         ("myth_solver_time_s", (), snap["time_s"]),
+        # stage-3 rewrite pass (docs/REWRITE_PASS.md)
+        (
+            "myth_solver_rewrite_discharged_total",
+            (),
+            snap["rewrite_discharged"],
+        ),
+        (
+            "myth_solver_assumption_reuse_total",
+            (),
+            snap["assumption_reuse"],
+        ),
+        ("myth_solver_core_minimized_total", (), snap["core_minimized"]),
+        ("myth_solver_rewrite_time_s", (), snap["rewrite_time_s"]),
+        (
+            "myth_solver_rewrite_bits_total",
+            (("stage", "before"),),
+            snap["rewrite_bits_before"],
+        ),
+        (
+            "myth_solver_rewrite_bits_total",
+            (("stage", "after"),),
+            snap["rewrite_bits_after"],
+        ),
     ]
 
 

@@ -92,7 +92,8 @@ class ResultCache:
         # unlike result entries: a constraint set's satisfiability does
         # not depend on budgets or module whitelists, so a resubmission
         # with different parameters still starts with warm verdicts.
-        self._solver_memos: "OrderedDict[bytes, Dict[bytes, int]]" = OrderedDict()
+        # NOT schema-independent, though — see _memo_key.
+        self._solver_memos: "OrderedDict[Tuple, Dict[bytes, int]]" = OrderedDict()
         self.solver_memo_max = 128
         self.hits = 0
         self.misses = 0
@@ -152,14 +153,27 @@ class ResultCache:
 
     # -- solver verdict memos (tentpole: cross-resubmission warmth) -----
 
+    @staticmethod
+    def _memo_key(key: bytes) -> Tuple:
+        """Solver memos are keyed by (code hash, fact schema version):
+        alpha digests are computed over constraint sets AFTER the static
+        planes have shaped them (static-UNSAT seeding, interval-discharge
+        rewriting), so verdicts exported under one fact schema must miss
+        — not resurrect — once the schema changes. Regression: memos
+        written before this keying survived schema bumps verbatim."""
+        from mythril_tpu.analysis.static_pass import FACT_SCHEMA_VERSION
+
+        return (key, FACT_SCHEMA_VERSION)
+
     def get_solver_memo(self, key: bytes) -> Optional[Dict[bytes, int]]:
         """The accumulated solver verdict memo for a code hash (a copy;
         seed it into solver_cache.GLOBAL before running the job)."""
+        mkey = self._memo_key(key)
         with self._lock:
-            memo = self._solver_memos.get(key)
+            memo = self._solver_memos.get(mkey)
             if memo is None:
                 return None
-            self._solver_memos.move_to_end(key)
+            self._solver_memos.move_to_end(mkey)
             return dict(memo)
 
     def put_solver_memo(self, key: bytes, memo: Dict[bytes, int]) -> None:
@@ -168,13 +182,14 @@ class ResultCache:
         have explored different regions)."""
         if not memo:
             return
+        mkey = self._memo_key(key)
         with self._lock:
-            entry = self._solver_memos.get(key)
+            entry = self._solver_memos.get(mkey)
             if entry is None:
                 entry = {}
-                self._solver_memos[key] = entry
+                self._solver_memos[mkey] = entry
             entry.update(memo)
-            self._solver_memos.move_to_end(key)
+            self._solver_memos.move_to_end(mkey)
             while len(self._solver_memos) > self.solver_memo_max:
                 self._solver_memos.popitem(last=False)
 

@@ -59,6 +59,15 @@ python -m pytest tests/laser/test_solver_cache.py \
     -q -p no:cacheprovider \
     -k "not on_device and not witness"
 
+echo "== rewrite-pass fast tests =="
+# stage-3 rule soundness against the evaluate oracle, interval
+# discharge, and memo-key stability — pure host-side, sub-second. The
+# host-CDCL-backed equisatisfiability and core-minimization tests run
+# with the full suite; -k trims to the oracle/engine half.
+python -m pytest tests/laser/test_rewrite_pass.py \
+    -q -p no:cacheprovider \
+    -k "rule or idempotent or transfer or fingerprint or structural"
+
 echo "== service fast tests =="
 # scheduler/cache/api lifecycle with the pipeline stubbed out — no
 # symbolic execution; the real multi-tenant integration runs in

@@ -770,6 +770,102 @@ def swc_declared():
     return problems
 
 
+def rewrite_soundness():
+    """Cross-file rule: every rewrite rule in
+    analysis/rewrite_pass/rules.py must be registered through the
+    ``@rule`` decorator carrying BOTH ``sound_for=`` and ``prop_test=``
+    keywords, the named property test must exist in
+    tests/laser/test_rewrite_pass.py, and nothing may touch the
+    ``RULES`` / ``_BY_OP`` registries outside the decorator body — an
+    unannotated or untested rule reaches every constraint set ahead of
+    the solvers, so a soundness bug there corrupts verdicts silently."""
+    rules_rel = "mythril_tpu/analysis/rewrite_pass/rules.py"
+    tests_rel = "tests/laser/test_rewrite_pass.py"
+    problems = []
+    tree = ast.parse((REPO / rules_rel).read_text())
+    tests_path = REPO / tests_rel
+    if not tests_path.exists():
+        return [f"{rules_rel}: property-test module {tests_rel} is missing"]
+    test_fns = {
+        node.name
+        for node in ast.walk(ast.parse(tests_path.read_text()))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name.startswith("test_")
+    }
+
+    decorator_span = None  # the rule() factory: registry writes allowed
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "rule":
+            decorator_span = (node.lineno, node.end_lineno)
+
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        decs = [
+            d
+            for d in node.decorator_list
+            if isinstance(d, ast.Call)
+            and isinstance(d.func, ast.Name)
+            and d.func.id == "rule"
+        ]
+        if not decs:
+            continue
+        for dec in decs:
+            kw = {k.arg: k.value for k in dec.keywords if k.arg}
+            if "sound_for" not in kw:
+                problems.append(
+                    f"{rules_rel}:{node.lineno}: rewrite rule "
+                    f"'{node.name}' lacks a sound_for= annotation"
+                )
+            if "prop_test" not in kw:
+                problems.append(
+                    f"{rules_rel}:{node.lineno}: rewrite rule "
+                    f"'{node.name}' names no prop_test="
+                )
+                continue
+            pt = kw["prop_test"]
+            if not (isinstance(pt, ast.Constant) and isinstance(pt.value, str)):
+                problems.append(
+                    f"{rules_rel}:{node.lineno}: prop_test of "
+                    f"'{node.name}' is not a string literal"
+                )
+            elif pt.value not in test_fns:
+                problems.append(
+                    f"{rules_rel}:{node.lineno}: prop_test "
+                    f"'{pt.value}' of '{node.name}' is not defined in "
+                    f"{tests_rel}"
+                )
+
+    for node in ast.walk(tree):
+        touches = None
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("RULES", "_BY_OP")
+            and node.func.attr not in ("get",)
+        ):
+            touches = node
+        elif (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("RULES", "_BY_OP")
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+        ):
+            touches = node
+        if touches is None:
+            continue
+        if decorator_span and (
+            decorator_span[0] <= touches.lineno <= decorator_span[1]
+        ):
+            continue
+        problems.append(
+            f"{rules_rel}:{touches.lineno}: rule registry mutated "
+            "outside the @rule decorator (unannotated registration)"
+        )
+    return problems
+
+
 def main() -> int:
     problems = []
     n_files = 0
@@ -812,6 +908,7 @@ def main() -> int:
         if source and not source.endswith("\n"):
             problems.append(f"{rel}: no newline at end of file")
     problems.extend(swc_declared())
+    problems.extend(rewrite_soundness())
     for problem in problems:
         print(problem)
     print(f"lint: {len(problems)} problem(s) in {n_files} files")

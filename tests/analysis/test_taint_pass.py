@@ -123,14 +123,21 @@ def test_golden_multiowner_candidates():
 
 
 def test_golden_schema_version_bumped():
-    # stage 2 added planes -> consumers keying artifacts on the fact
-    # schema (service/cache.py) must see a version > the PR 1 layout
-    assert FACT_SCHEMA_VERSION == 2
+    # stage 2 added planes, stage 3 added cond_intervals -> consumers
+    # keying artifacts on the fact schema (service/cache.py) must see a
+    # version > the PR 1 layout
+    assert FACT_SCHEMA_VERSION == 3
     a = build(bench_code("token"))
     for plane in ("taint_mask", "jumpi_verdict", "module_relevance",
                   "swc_mask"):
         assert np.asarray(getattr(a, plane)).shape == (a.code_len,)
     assert np.asarray(a.effect_flags).shape == (a.n_blocks,)
+    # the stage-3 plane: byte-pc -> MUST (lo, hi) bounds on the JUMPI
+    # condition word at reachable JUMPI sites
+    assert isinstance(a.cond_intervals, dict)
+    for pc, (lo, hi) in a.cond_intervals.items():
+        assert 0 <= pc < a.code_len
+        assert 0 <= lo <= hi
 
 
 def test_golden_codebank_swc_plane():

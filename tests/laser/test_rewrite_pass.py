@@ -1,0 +1,505 @@
+"""Stage-3 rewrite pass (analysis/rewrite_pass/): per-rule soundness
+against the ``terms.evaluate`` oracle, set-level equisatisfiability vs
+a fresh host CDCL core, interval discharge agreeing with the host,
+memo-key stability under rewriting, UNSAT seed feedback, witness
+reuse, and prefix-core minimization.
+
+The ``test_rule_*`` names are load-bearing: each rewrite rule's
+``prop_test=`` annotation names its test here, and the lint rule
+``rewrite_soundness`` (scripts/lint.py) fails if a rule names a test
+this module does not define."""
+
+import random
+
+import pytest
+
+from mythril_tpu.analysis import rewrite_pass as rw
+from mythril_tpu.analysis.rewrite_pass import engine, intervals
+from mythril_tpu.laser.tpu import solver_cache as sc
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.solver.incremental import IncrementalCore, get_core
+from mythril_tpu.smt.terms import EvalEnv
+
+W = 16  # small words keep the host CDCL and the oracle fast
+
+
+@pytest.fixture(autouse=True)
+def _fresh_incremental_core():
+    # The process-global host core accumulates clauses from any earlier
+    # symbolic-execution test in the session (observed: 2.4M clauses
+    # after bectoken), and a loaded core can blow decide_batch's 100 ms
+    # inline budget on a trivial set — turning a deterministic host
+    # verdict into UNKNOWN. These tests assert exact verdicts, so they
+    # get a fresh core.
+    get_core().reset()
+    yield
+
+SAT, UNSAT, UNKNOWN = sc.SAT, sc.UNSAT, sc.UNKNOWN
+
+
+def x(name):
+    return terms.bv_var(name, W)
+
+
+def k(v):
+    return terms.bv_const(v & terms.mask(W), W)
+
+
+def free_bv_vars(roots):
+    """name -> size for every bv var in the forest."""
+    out = {}
+    for t in terms.post_order(list(roots)):
+        if t.op == "var":
+            out[t.params[0]] = t.size
+    return out
+
+
+def rand_env(roots, rng):
+    names = free_bv_vars(roots)
+    return EvalEnv(
+        bv_values={n: rng.randrange(1 << s) for n, s in names.items()}
+    )
+
+
+def assert_equiv(orig, rewritten, rng, n=60):
+    """Assignment-wise equality of two bool terms under the oracle."""
+    for _ in range(n):
+        env = rand_env([orig, rewritten], rng)
+        memo = {}
+        assert terms.evaluate(orig, env, memo) == terms.evaluate(
+            rewritten, env, memo
+        ), "rewrite changed the value of %s -> %s" % (orig.op, rewritten.op)
+
+
+def rewritten_of(t):
+    out = engine.rewrite_term(t)
+    return out
+
+
+def fresh_host_verdict(raw_terms):
+    """Ground truth: a generously-budgeted check on a PRIVATE core."""
+    return sc._host_check(list(raw_terms), 10_000, core=IncrementalCore())
+
+
+# ---------------------------------------------------------------------------
+# per-rule property tests (names referenced by prop_test= annotations)
+# ---------------------------------------------------------------------------
+
+
+def test_rule_not_cmp():
+    rng = random.Random(101)
+    a, b = x("nc_a"), x("nc_b")
+    for mk in (terms.bool_ult, terms.bool_ule, terms.bool_slt, terms.bool_sle):
+        t = terms.bool_not(mk(a, b))
+        out = rewritten_of(t)
+        assert out.op != "bnot"  # polarity canonicalized away
+        assert_equiv(t, out, rng)
+
+
+def test_rule_cmp_bounds():
+    rng = random.Random(102)
+    a = x("cb_a")
+    cases = [
+        (terms.bool_ult(a, k(0)), terms.FALSE),
+        (terms.bool_ult(a, k(1)), terms.bool_eq(a, k(0))),
+        (terms.bool_ult(k(terms.mask(W)), a), terms.FALSE),
+        (terms.bool_ult(k(0), a), terms.bool_not(terms.bool_eq(a, k(0)))),
+        (terms.bool_ule(a, k(terms.mask(W))), terms.TRUE),
+        (terms.bool_ule(a, k(0)), terms.bool_eq(a, k(0))),
+        (terms.bool_ule(k(0), a), terms.TRUE),
+    ]
+    for t, expected in cases:
+        out = rewritten_of(t)
+        assert out is expected, (t.op, out.op)
+        if expected not in (terms.TRUE, terms.FALSE):
+            assert_equiv(t, out, rng, n=30)
+
+
+def test_rule_eq_shift():
+    rng = random.Random(103)
+    a, b = x("es_a"), x("es_b")
+    shapes = [
+        terms.bool_eq(terms.bv_add(a, k(7)), k(19)),
+        terms.bool_eq(terms.bv_not(a), k(0x1234)),
+        terms.bool_eq(terms.bv_sub(a, b), k(0)),
+        terms.bool_eq(terms.bv_xor(a, b), k(0)),
+        terms.bool_eq(terms.bv_neg(a), k(0)),
+    ]
+    for t in shapes:
+        out = rewritten_of(t)
+        assert out is not t  # every shape above must fire
+        assert_equiv(t, out, rng)
+    # the shifted form compares a BARE var against a literal
+    folded = rewritten_of(terms.bool_eq(terms.bv_add(a, k(7)), k(19)))
+    assert folded.op == "eq"
+    assert any(s.is_const and s.value == (19 - 7) for s in folded.args)
+
+
+def test_rule_ite_lift():
+    rng = random.Random(104)
+    c = terms.bool_ult(x("il_c"), k(100))
+    boolword = terms.bv_ite(c, k(1), k(0))
+    # the Solidity bool-storage pattern collapses to the condition
+    assert rewritten_of(terms.bool_eq(boolword, k(1))) is rewritten_of(
+        engine.rewrite_term(c)
+    )
+    for t in (
+        terms.bool_eq(boolword, k(0)),
+        terms.bool_ult(boolword, k(1)),
+        terms.bool_ule(k(1), terms.bv_ite(c, k(3), k(0))),
+        terms.bool_slt(terms.bv_ite(c, k(5), k(9)), k(7)),
+    ):
+        out = rewritten_of(t)
+        assert out.op not in ("eq", "ult", "ule", "slt", "sle") or all(
+            a.op != "ite" for a in out.args
+        )
+        assert_equiv(t, out, rng)
+
+
+def test_rule_bool_complement():
+    p = terms.bool_ult(x("bc_a"), x("bc_b"))
+    q = terms.bool_eq(x("bc_c"), k(3))
+    assert rewritten_of(
+        terms.bool_and(p, q, terms.bool_not(p))
+    ) is terms.FALSE
+    assert rewritten_of(terms.bool_or(q, p, terms.bool_not(p))) is terms.TRUE
+
+
+def test_rule_slice_eq_split():
+    rng = random.Random(106)
+    a, b = x("se_a"), x("se_b")
+    t = terms.bool_eq(terms.bv_concat([a, b]), terms.bv_const(0xABCD1234, 32))
+    out = rewritten_of(t)
+    assert out.op == "band"  # split along the concat seam
+    assert_equiv(t, out, rng)
+    # zext: in-range narrows, out-of-range refutes
+    t2 = terms.bool_eq(terms.bv_zext(16, a), terms.bv_const(0x12, 32))
+    out2 = rewritten_of(t2)
+    assert out2.op == "eq" and all(s.size == W for s in out2.args)
+    assert_equiv(t2, out2, rng)
+    t3 = terms.bool_eq(terms.bv_zext(16, a), terms.bv_const(1 << 20, 32))
+    assert rewritten_of(t3) is terms.FALSE
+
+
+def test_rule_pow2_strength():
+    rng = random.Random(107)
+    a = x("p2_a")
+    for t, op in (
+        (terms.bv_mul(a, k(8)), "shl"),
+        (terms.bv_mul(k(64), a), "shl"),
+        (terms.bv_udiv(a, k(16)), "lshr"),
+        (terms.bv_urem(a, k(32)), "zext"),
+    ):
+        out = engine.rewrite_term(t)
+        assert out.op == op, (t.op, out.op)
+        # bv equivalence through an equality probe against a shared var
+        probe = x("p2_probe")
+        assert_equiv(
+            terms.bool_eq(t, probe), terms.bool_eq(out, probe), rng, n=40
+        )
+    assert engine.rewrite_term(terms.bv_urem(a, k(1))).is_const
+
+
+# ---------------------------------------------------------------------------
+# set-level soundness: equisatisfiability vs a fresh host core
+# ---------------------------------------------------------------------------
+
+
+def random_sets(seed, count=12):
+    rng = random.Random(seed)
+    out = []
+    for i in range(count):
+        a, b, c = (x("rs%d_%s" % (i, n)) for n in "abc")
+        k1, k2, k3 = (k(rng.randrange(1, 1 << W)) for _ in range(3))
+        pool = [
+            terms.bool_eq(terms.bv_add(a, k1), k2),
+            terms.bool_ult(a, k2),
+            terms.bool_not(terms.bool_ult(b, k3)),
+            terms.bool_eq(terms.bv_mul(b, k(4)), k3),
+            terms.bool_eq(terms.bv_ite(terms.bool_ult(c, k1), k(1), k(0)), k(1)),
+            terms.bool_ule(terms.bv_xor(a, b), k3),
+            terms.bool_eq(terms.bv_urem(c, k(8)), k(rng.randrange(8))),
+        ]
+        rng.shuffle(pool)
+        out.append(pool[: rng.randrange(2, 6)])
+    return out
+
+
+def test_rewrite_set_equisat_with_host():
+    for cs in random_sets(201):
+        oc = rw.rewrite_set(cs)
+        original = fresh_host_verdict(cs)
+        if oc.verdict is not None:
+            want = SAT if oc.verdict else UNSAT
+            assert original in (want, UNKNOWN), (
+                "static verdict %s disagrees with host %s" % (oc.verdict, original)
+            )
+        else:
+            residual = fresh_host_verdict(oc.terms)
+            if UNKNOWN not in (original, residual):
+                assert original == residual
+
+
+def test_rewrite_set_idempotent():
+    for cs in random_sets(202, count=8):
+        oc = rw.rewrite_set(cs)
+        again = rw.rewrite_set(oc.terms)
+        assert tuple(t.uid for t in again.terms) == tuple(
+            t.uid for t in oc.terms
+        )
+        assert again.verdict == oc.verdict
+
+
+# ---------------------------------------------------------------------------
+# interval discharge (incl. seeded facts) vs host
+# ---------------------------------------------------------------------------
+
+
+def encode_seed(var, lo, hi):
+    return [
+        terms.bool_ule(k(lo), var),
+        terms.bool_ule(var, k(hi)),
+    ]
+
+
+def test_interval_discharge_agrees_with_host():
+    rng = random.Random(301)
+    for i in range(25):
+        v = x("iv%d" % i)
+        lo = rng.randrange(0, 1 << W)
+        hi = rng.randrange(lo, 1 << W)
+        cmp_k = k(rng.randrange(1 << W))
+        t = rng.choice(
+            [
+                terms.bool_ult(v, cmp_k),
+                terms.bool_ule(cmp_k, v),
+                terms.bool_eq(v, cmp_k),
+                terms.bool_not(terms.bool_eq(v, cmp_k)),
+            ]
+        )
+        oc = rw.rewrite_set([t], seeds={v.uid: (lo, hi)})
+        if oc.verdict is None:
+            continue
+        # host sees the seed as explicit range constraints
+        host = fresh_host_verdict([t] + encode_seed(v, lo, hi))
+        assert host == (SAT if oc.verdict else UNSAT), (
+            "seeded discharge %s vs host %s for %s in [%d,%d] vs %d"
+            % (oc.verdict, host, t.op, lo, hi, cmp_k.value)
+        )
+
+
+def test_structural_discharge_is_flagged_structural():
+    v = x("sd_a")
+    # x < x is false for every assignment — structural
+    oc = rw.rewrite_set([terms.bool_ult(v, v)])
+    assert oc.verdict is False and oc.core_is_structural
+    # x == 7 refuted ONLY by the seed — must not be marked structural
+    oc2 = rw.rewrite_set(
+        [terms.bool_eq(v, k(7))], seeds={v.uid: (9, 12)}
+    )
+    assert oc2.verdict is False and not oc2.core_is_structural
+
+
+def test_interval_transfer_spot_checks():
+    v = x("it_a")
+    iv = intervals.compute([terms.bv_add(v, k(5))])
+    # var is unconstrained: full range
+    assert iv[v.uid] == (0, terms.mask(W))
+    add = terms.bv_add(v, k(5))
+    seeded = intervals.compute([add], seeds={v.uid: (10, 20)})
+    assert seeded[add.uid] == (15, 25)
+
+
+# ---------------------------------------------------------------------------
+# memo-key stability (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_alpha_fingerprint_stable_under_rewrite():
+    """The memo keys decide_batch uses are computed over REWRITTEN
+    forms; rewriting is idempotent, so keying a set and keying its
+    already-rewritten self produce the same digest."""
+    for cs in random_sets(401, count=8):
+        once = rw.rewrite_set(cs).terms
+        twice = rw.rewrite_set(once).terms
+        d1 = sc.canonical_fingerprint(once)
+        d2 = sc.canonical_fingerprint(twice)
+        assert d1 == d2 and d1 is not None
+
+
+def test_alpha_fingerprint_merges_renamed_sets():
+    """Alpha-equivalent (renamed) sets still share a digest after the
+    rewrite: canonicalization must not break rename-insensitivity."""
+
+    def build(prefix):
+        a, b = x(prefix + "_a"), x(prefix + "_b")
+        return [
+            terms.bool_eq(terms.bv_add(a, k(3)), k(9)),
+            terms.bool_ult(b, k(100)),
+            terms.bool_not(terms.bool_ult(b, a)),
+        ]
+
+    d1 = sc.canonical_fingerprint(rw.rewrite_set(build("left")).terms)
+    d2 = sc.canonical_fingerprint(rw.rewrite_set(build("right")).terms)
+    assert d1 == d2 and d1 is not None
+
+
+def test_decide_batch_alpha_hit_across_renaming():
+    """End to end: a decided set warms the memo for its RENAMED twin
+    even though both were rewritten before keying."""
+    cache = sc.SolverCache()
+
+    def build(prefix):
+        a = x(prefix + "_v")
+        return [
+            terms.bool_eq(terms.bv_add(a, k(11)), k(23)),
+            terms.bool_ult(a, k(1000)),
+        ]
+
+    v1 = cache.decide_batch([build("one")], use_device=False)
+    assert v1 == [True]
+    v2 = cache.decide_batch([build("two")], use_device=False)
+    assert v2 == [True]
+    snap = cache.snapshot()
+    assert snap["hits_alpha"] == 1 and snap["host_decided"] == 1
+
+
+# ---------------------------------------------------------------------------
+# UNSAT seeds from discharge (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_discharged_set_records_unsat_seed():
+    cache = sc.SolverCache()
+    v = x("us_a")
+    contradiction = terms.bool_ult(v, k(0))  # rewrites to FALSE
+    assert cache.decide_batch([[contradiction]], use_device=False) == [False]
+    assert cache.snapshot()["rewrite_discharged"] == 1
+    # the raw term is now a global prune fact (bridge consults this)
+    assert rw.known_unsat_uid(contradiction.uid)
+    # and any superset is statically UNSAT on its next appearance
+    other = terms.bool_eq(x("us_b"), k(5))
+    assert cache.decide_batch(
+        [[other, contradiction]], use_device=False
+    ) == [False]
+    assert cache.snapshot()["host_decided"] == 0  # no solver ever ran
+
+
+def test_seeded_refutation_stays_scoped():
+    """A seed-dependent refutation must NOT enter the process-global
+    known-unsat set: the fact planes it leaned on are per-contract."""
+    cache = sc.SolverCache()
+    v = x("sr_a")
+    t = terms.bool_eq(v, k(7))
+    verdicts = cache.decide_batch(
+        [[t]], use_device=False, interval_seeds=[{v.uid: (9, 12)}]
+    )
+    assert verdicts == [False]
+    assert not rw.known_unsat_uid(t.uid)
+    assert not rw.known_unsat_uid(engine.rewrite_term(t).uid)
+
+
+# ---------------------------------------------------------------------------
+# assumption reuse: witness replay (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_witness_reuse_answers_child_without_solve():
+    cache = sc.SolverCache()
+    v = x("wr_a")
+    parent = [terms.bool_eq(v, k(5))]
+    model = {("bv", "wr_a", W): 5}
+    cache.record(parent, SAT, model=model, path_fp=777)
+    child = parent + [terms.bool_ult(v, k(10))]
+    verdicts = cache.decide_batch(
+        [child], use_device=False, hints=[(777,)]
+    )
+    assert verdicts == [True]
+    snap = cache.snapshot()
+    assert snap["assumption_reuse"] == 1
+    assert snap["host_decided"] == 0  # answered by replay, not a solve
+
+
+def test_witness_that_fails_is_not_a_verdict():
+    cache = sc.SolverCache()
+    v = x("wf_a")
+    parent = [terms.bool_eq(v, k(5))]
+    cache.record(parent, SAT, model={("bv", "wf_a", W): 5}, path_fp=778)
+    child = parent + [terms.bool_ult(k(10), v)]  # witness violates this
+    verdicts = cache.decide_batch([child], use_device=False, hints=[(778,)])
+    # the host decides (UNSAT here); replay must not have answered SAT
+    assert verdicts == [False]
+    assert cache.snapshot()["assumption_reuse"] == 0
+
+
+def test_try_witness_oracle():
+    v, u = x("tw_a"), x("tw_b")
+    terms_list = [terms.bool_ult(v, u), terms.bool_eq(u, k(9))]
+    assert rw.try_witness(terms_list, {("bv", "tw_a", W): 3, ("bv", "tw_b", W): 9})
+    assert not rw.try_witness(terms_list, {("bv", "tw_a", W): 9, ("bv", "tw_b", W): 9})
+    assert not rw.try_witness(terms_list, None)
+
+
+# ---------------------------------------------------------------------------
+# UNSAT prefix-core minimization (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_minimize_unsat_prefix_shrinks():
+    a, b = x("mp_a"), x("mp_b")
+    # contradiction closes at index 1; the tail is irrelevant
+    raw = [
+        terms.bool_ult(k(9), a),
+        terms.bool_ult(a, k(5)),
+        terms.bool_eq(b, k(3)),
+        terms.bool_ule(b, a),
+    ]
+    core = IncrementalCore()
+    prefix = rw.minimize_unsat_prefix(core, raw, timeout_ms=5000, max_probes=16)
+    assert prefix is not None and len(prefix) == 2
+    assert fresh_host_verdict(list(prefix)) == UNSAT
+
+
+def test_minimize_rejects_sat_sets():
+    a = x("ms_a")
+    core = IncrementalCore()
+    assert (
+        rw.minimize_unsat_prefix(core, [terms.bool_ult(a, k(5))], timeout_ms=5000)
+        is None
+    )
+
+
+def test_host_unsat_path_records_minimized_core():
+    cache = sc.SolverCache()
+    a, b = x("hm_a"), x("hm_b")
+    contr = [terms.bool_ult(k(9), a), terms.bool_ult(a, k(5))]
+    full = contr + [terms.bool_eq(b, k(3))]
+    assert cache.decide_batch([full], use_device=False) == [False]
+    assert cache.snapshot()["core_minimized"] == 1
+    # the shorter core now subsumes OTHER supersets without a solve
+    other = contr + [terms.bool_eq(x("hm_c"), k(8))]
+    assert cache.decide_batch([other], use_device=False) == [False]
+    snap = cache.snapshot()
+    assert snap["host_decided"] == 1  # only the first set was solved
+
+
+# ---------------------------------------------------------------------------
+# the MYTHRIL_TPU_REWRITE=0 control arm
+# ---------------------------------------------------------------------------
+
+
+def test_control_arm_disables_stage(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_REWRITE", "0")
+    assert not rw.enabled()
+    cache = sc.SolverCache()
+    v = x("ca_a")
+    verdicts = cache.decide_batch(
+        [[terms.bool_ult(v, k(0))]], use_device=False
+    )
+    # still decided (the host sees the raw contradiction), but by a
+    # SOLVE, not by the rewrite stage
+    assert verdicts == [False]
+    snap = cache.snapshot()
+    assert snap["rewrite_discharged"] == 0
+    assert snap["rewrite_time_s"] == 0.0
+    assert snap["host_decided"] == 1

@@ -13,9 +13,19 @@ from mythril_tpu.laser.tpu import solver_cache as sc
 from mythril_tpu.laser.tpu import solver_jax as sj
 from mythril_tpu.robustness import faults
 from mythril_tpu.smt import ULT, UGT, symbol_factory
-from mythril_tpu.smt.solver.incremental import IncrementalCore
+from mythril_tpu.smt.solver.incremental import IncrementalCore, get_core
 
 W = 16
+
+
+@pytest.fixture(autouse=True)
+def _fresh_incremental_core():
+    # these tests compare memoized verdicts bit-for-bit against a fresh
+    # host solve — a process-global core loaded by earlier suite tests
+    # can exhaust the inline budget and memoize UNKNOWN where a fresh
+    # core decides, which is exactly the confusion this file polices
+    get_core().reset()
+    yield
 
 
 def bv(name):

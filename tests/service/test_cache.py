@@ -79,3 +79,25 @@ def test_fact_schema_version_invalidates_entries(monkeypatch):
     assert static_pass.FACT_SCHEMA_VERSION in cache_mod._normalize_params(
         1, None, None
     )
+
+
+def test_fact_schema_version_invalidates_solver_memos(monkeypatch):
+    """Regression: solver verdict memos were keyed by code hash alone
+    and survived fact-schema bumps verbatim — but alpha digests are
+    computed over constraint sets AFTER the static planes have shaped
+    them (static-UNSAT seeding, stage-3 rewriting), so a memo exported
+    under one schema must miss, not resurrect, under the next."""
+    cache = ResultCache()
+    key = cache_key("", "6001")
+    memo = {b"\x01" * 16: 20}
+    cache.put_solver_memo(key, memo)
+    assert cache.get_solver_memo(key) == memo
+    monkeypatch.setattr(
+        static_pass, "FACT_SCHEMA_VERSION", static_pass.FACT_SCHEMA_VERSION + 1
+    )
+    assert cache.get_solver_memo(key) is None
+    # writes under the new schema land in a fresh bucket and do not
+    # merge with (or revive) the old one
+    memo2 = {b"\x02" * 16: 30}
+    cache.put_solver_memo(key, memo2)
+    assert cache.get_solver_memo(key) == memo2
