@@ -202,6 +202,11 @@ def _steady_analysis(
                 "fused_k_p50": _sample_pct(ks, 50),
                 "fused_k_p95": _sample_pct(ks, 95),
                 "device_pruned_lanes": tpu_strategy.device_pruned_lanes,
+                # fused MESH accounting (docs/MESH.md): zero on a
+                # single-device run, populated when _mesh_tier shards
+                "steal_events": tpu_strategy.mesh_steal_events,
+                "steal_volume_lanes": tpu_strategy.mesh_steal_lanes,
+                "frontier_occupancy": tpu_strategy.mesh_occupancy or None,
             }
     return meter, sorted({i.swc_id for i in issues}), pruned, tpu
 
@@ -404,6 +409,9 @@ def _emit(progress: dict) -> None:
                 "fused_k_p50": progress.get("fused_k_p50"),
                 "fused_k_p95": progress.get("fused_k_p95"),
                 "device_pruned_lanes": progress.get("device_pruned_lanes"),
+                "steal_events": progress.get("steal_events"),
+                "steal_volume_lanes": progress.get("steal_volume_lanes"),
+                "frontier_occupancy": progress.get("frontier_occupancy"),
                 "round_phase_p50_ms": progress.get("round_phase_p50_ms"),
                 "round_phase_p95_ms": progress.get("round_phase_p95_ms"),
                 "lanes": progress.get("lanes"),

@@ -142,6 +142,12 @@ class TpuBatchStrategy(BasicSearchStrategy):
         self.fused_k_samples: List[int] = []
         self.device_pruned_lanes = 0
         self.device_wall_s = 0.0
+        # fused-mesh accounting (docs/MESH.md): ICI work-steal exchanges
+        # fired between super-round iterations, lanes they moved, and
+        # the last observed per-shard frontier occupancy vector
+        self.mesh_steal_events = 0
+        self.mesh_steal_lanes = 0
+        self.mesh_occupancy: List[int] = []
         # device-side SWC candidate sites: statically-flagged pcs
         # (CodeBank.swc_mask) some device lane actually visited this
         # analysis, keyed by SWC id. Candidates, not findings — the host
@@ -599,30 +605,51 @@ MESH_STEPS_PER_ROUND = 256
 # mesh execution policy: "auto" shards over every visible accelerator
 # device but stays single-device on the CPU backend (the virtual-8-CPU
 # test mesh makes EVERY analysis pay SPMD partitioning cost otherwise);
-# "on" forces sharding (the dedicated virtual-mesh integration test),
-# "off" forces the single-device path.
+# "on" forces sharding (the dedicated virtual-mesh integration tests),
+# "sync" forces sharding but pins the legacy one-round-per-dispatch
+# loop (the fused-mesh degrade tier, docs/MESH.md), "off" forces the
+# single-device path. MYTHRIL_TPU_MESH overrides per process.
 MESH_MODE = "auto"
 
-
-def _use_mesh(n_devices: int, platform: str) -> bool:
-    if MESH_MODE == "on":
-        return n_devices > 1
-    if MESH_MODE == "off":
-        return False
-    return n_devices > 1 and platform != "cpu"
+# watchdog headroom multiplier while the mesh tier is active: fused
+# super-rounds additionally pay psum/all-gather/all-to-all collective
+# latency per round, which the single-device EMA never saw
+MESH_WATCHDOG_FACTOR = 1.5
 
 
-_mesh_stats_warned = [False]
+def _mesh_tier(n_devices: int, platform: str) -> str:
+    """Which mesh tier the next device round runs: "off" (single
+    device), "sync" (legacy sharded slice loop), or "fused" (the
+    shard_map megakernel with ICI work-stealing). The fused tier obeys
+    the same breaker half-open degrade as the single-device megakernel:
+    trial rounds probe the device through the simpler sync machinery."""
+    mode = os.environ.get("MYTHRIL_TPU_MESH", MESH_MODE).lower()
+    if mode not in ("auto", "on", "off", "sync"):
+        log.warning("bad MYTHRIL_TPU_MESH=%r ignored", mode)
+        mode = MESH_MODE
+    if n_devices < 2 or mode == "off":
+        return "off"
+    if mode == "auto" and platform == "cpu":
+        return "off"
+    if mode == "sync":
+        return "sync"
+    return "fused" if _fused_enabled() else "sync"
 
 
-def _warn_mesh_stats_once() -> None:
-    if not _mesh_stats_warned[0]:
-        _mesh_stats_warned[0] = True
-        log.warning(
-            "instruction profiling of device rounds is not collected on "
-            "the multi-device mesh path; the profiler will only show "
-            "host-executed opcodes"
-        )
+def planned_mesh_factor() -> float:
+    """Watchdog multiplier for the tier the next round will run —
+    robustness/retry.py folds this into the round watchdog alongside
+    planned_fused_k() so mesh collective latency is never mistaken for
+    a wedged device."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        tier = _mesh_tier(len(devices), devices[0].platform)
+    except Exception as e:  # pragma: no cover - device enumeration failed
+        log.debug("mesh factor: device enumeration failed (%s)", e)
+        return 1.0
+    return MESH_WATCHDOG_FACTOR if tier != "off" else 1.0
 
 
 # steps per deadline check: a full DEVICE_STEP_BUDGET round can take
@@ -769,12 +796,13 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
         # into exec_batch when this round runs the sync/mesh path
         bridge.fused_round_info = None
         bridge.fused_pruned_visited = None
+        bridge.mesh_n_shards = 1
     devices = jax.devices()
     n_shards = len(devices)
-    if (
-        not _use_mesh(n_shards, devices[0].platform)
-        or cfg.lanes % n_shards != 0
-    ):
+    tier = _mesh_tier(n_shards, devices[0].platform)
+    if cfg.lanes % n_shards != 0:
+        tier = "off"
+    if tier == "off":
         if _fused_enabled():
             return _run_device_fused(
                 cb, st, cfg, want_stats=want_stats, deadline=deadline,
@@ -804,17 +832,31 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
             if deadline is not None and time.time() > deadline:
                 break
         return st, hist
-    if want_stats:
-        _warn_mesh_stats_once()
 
-    mesh = mesh_lib.make_mesh()
+    if bridge is not None:
+        # per-shard download bucketing (transfer.batch_to_host) keys off
+        # this: the mesh compaction leaves one dense prefix PER shard
+        bridge.mesh_n_shards = n_shards
+    mesh = mesh_lib.make_mesh(n_shards)
     st = mesh_lib.shard_batch(st, mesh)
     cb, env = mesh_lib.put_replicated((cb, default_env()), mesh)
+    if tier == "fused":
+        return _run_mesh_fused(
+            mesh, n_shards, cb, env, st, want_stats=want_stats,
+            deadline=deadline, bridge=bridge,
+        )
+
+    # sync degrade tier: one sharded round per dispatch. Quiescence and
+    # rebalance gating both read the occupancy vector the PREVIOUS
+    # dispatch computed on device — one i32[n_shards] fetch per round
+    # instead of the full alive plane plus a separate occupancy pull.
     steps_done = 0
+    occ = None
     while steps_done < DEVICE_STEP_BUDGET:
         _cat.DEVICE_SLICES_TOTAL.inc()
-        do_reb = mesh_lib.should_rebalance(st, n_shards)
-        st = mesh_lib.sharded_round(
+        do_reb = occ is not None and mesh_lib.should_rebalance_occ(occ)
+        t0 = time.time()
+        st, occ_dev = mesh_lib.sharded_round(
             cb,
             env,
             st,
@@ -822,18 +864,138 @@ def _run_device(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
             do_rebalance=do_reb,
             n_shards=n_shards,
         )
+        occ = np.asarray(occ_dev)  # the one blocking fetch this round
+        _cat.ROUND_PHASE_S.observe(time.time() - t0, "device_round_iter")
+        obs.TRACER.cut(
+            "mesh_round", "device_round_iter", shards=n_shards,
+            rebalanced=bool(do_reb),
+        )
         steps_done += MESH_STEPS_PER_ROUND
         if bridge is not None:
             drained = _drain_ss_rings(bridge, st)
             if drained is not st:
-                # the replace built unsharded planes; restore the lane
-                # sharding before the next pjit round
+                # the replace built unsharded planes (and resumed TRAP_SS
+                # lanes, so the fetched occ is stale); restore the lane
+                # sharding and force a fresh occupancy next round
                 st = mesh_lib.shard_batch(drained, mesh)
-        if not bool(np.asarray(st.alive & (st.status == _RUNNING)).any()):
+                occ = None
+        if occ is not None and int(occ.sum()) == 0:
             break
         if deadline is not None and time.time() > deadline:
             break
+    obs.TRACER.end_cut("mesh_round")
     return st, None
+
+
+def _run_mesh_fused(
+    mesh, n_shards, cb, env, st, want_stats=False, deadline=None, bridge=None
+):
+    """Fused MESH path: the megakernel super-round runs under shard_map
+    over lane-sharded planes, with on-device ICI work-stealing between
+    rounds (megakernel.run_fused_mesh, docs/MESH.md). Host-sync cadence
+    and totals accounting mirror _run_device_fused; the extended info
+    vector additionally carries steal counters and the per-shard
+    frontier occupancy, which feed the myth_mesh_* gauges without any
+    extra device fetch."""
+    from mythril_tpu.laser.tpu import megakernel, mesh as mesh_lib
+
+    k = _pick_fused_k()
+    rounds_left = k
+    hist = None
+    pruned_visited = None
+    totals = {
+        "k": k,
+        "rounds": 0,
+        "syncs": 0,
+        "k_samples": [],
+        "pruned_lanes": 0,
+        "pruned_steps": 0,
+        "pruned_static": 0,
+        "device_wall_s": 0.0,
+        "n_shards": n_shards,
+        "steal_events": 0,
+        "steal_lanes": 0,
+        "occupancy": [],
+    }
+    while rounds_left > 0:
+        dispatch = rounds_left
+        if deadline is not None:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            cost = _fused_round_cost_s[0]
+            if cost > 0:
+                dispatch = min(dispatch, max(1, int(remaining / cost)))
+        _cat.DEVICE_SLICES_TOTAL.inc()
+        t0 = time.time()
+        fo = megakernel.run_fused_mesh(
+            mesh,
+            cb,
+            env,
+            st,
+            max_rounds=dispatch,
+            steps_per_round=DEVICE_SLICE_STEPS,
+            with_stats=want_stats,
+        )
+        st = fo.st
+        stats = megakernel.decode_mesh_info(fo.info, n_shards)  # one fetch
+        wall = time.time() - t0
+        totals["syncs"] += 1
+        totals["rounds"] += stats.rounds
+        totals["k_samples"].append(stats.rounds)
+        totals["pruned_lanes"] += stats.pruned_lanes
+        totals["pruned_steps"] += stats.pruned_steps
+        totals["pruned_static"] += stats.pruned_static
+        totals["device_wall_s"] += wall
+        totals["steal_events"] += stats.steal_events
+        totals["steal_lanes"] += stats.steal_lanes
+        totals["occupancy"] = list(stats.occupancy)
+        for shard, occ_v in enumerate(stats.occupancy):
+            _cat.MESH_FRONTIER_OCCUPANCY.set(occ_v, str(shard))
+        if stats.steal_events:
+            _cat.MESH_STEAL_EVENTS_TOTAL.inc(stats.steal_events)
+            _cat.MESH_STEAL_LANES_TOTAL.inc(stats.steal_lanes)
+            obs.TRACER.cut(
+                "mesh_steal", "steal", events=stats.steal_events,
+                lanes=stats.steal_lanes,
+            )
+            obs.TRACER.end_cut("mesh_steal")
+        if stats.pruned_lanes:
+            pv = np.asarray(fo.pruned_visited)
+            pruned_visited = (
+                pv if pruned_visited is None else (pruned_visited | pv)
+            )
+        if want_stats:
+            hist = fo.hist if hist is None else hist + fo.hist
+        if stats.rounds:
+            sample = wall / stats.rounds
+            prev = _fused_round_cost_s[0]
+            _fused_round_cost_s[0] = (
+                sample if not prev else 0.5 * prev + 0.5 * sample
+            )
+            for _ in range(stats.rounds):
+                _cat.ROUND_PHASE_S.observe(sample, "device_round_iter")
+                obs.TRACER.cut(
+                    "fused_round", "device_round_iter", rounds=stats.rounds,
+                    shards=n_shards,
+                )
+            obs.TRACER.end_cut("fused_round")
+        rounds_left -= max(1, stats.rounds)
+        resumed = False
+        if bridge is not None:
+            drained = _drain_ss_rings(bridge, st)
+            if drained is not st:
+                # resumed TRAP_SS lanes invalidate the fetched running
+                # count; reshard and let the next dispatch re-derive it
+                st = mesh_lib.shard_batch(drained, mesh)
+                resumed = True
+        if not resumed and stats.n_running == 0:
+            # quiescence straight from the info vector — no extra fetch
+            break
+    if bridge is not None:
+        bridge.fused_round_info = totals
+        bridge.fused_pruned_visited = pruned_visited
+    return st, hist
 
 
 def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None):
@@ -848,10 +1010,7 @@ def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None)
     events, so ``rounds_per_host_sync`` stays ~K. Per-dispatch stats
     (rounds retired, lanes pruned on device, their step/coverage
     accumulators) ride back to exec_batch on the bridge."""
-    import jax.numpy as jnp
-
     from mythril_tpu.laser.tpu import megakernel
-    from mythril_tpu.laser.tpu.batch import RUNNING as _RUNNING
 
     k = _pick_fused_k()
     rounds_left = k
@@ -923,9 +1082,16 @@ def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None)
                 )
             obs.TRACER.end_cut("fused_round")
         rounds_left -= max(1, stats.rounds)
+        resumed = False
         if bridge is not None:
-            st = _drain_ss_rings(bridge, st)
-        if not bool(np.asarray(st.alive & (st.status == _RUNNING)).any()):
+            drained = _drain_ss_rings(bridge, st)
+            if drained is not st:
+                # the drain resumed TRAP_SS lanes, so the info vector's
+                # running count is stale — re-dispatch and re-derive it
+                st = drained
+                resumed = True
+        if not resumed and stats.n_running == 0:
+            # quiescence straight from the info vector — no extra fetch
             break
     if bridge is not None:
         bridge.fused_round_info = totals
@@ -1454,6 +1620,10 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             strategy.fused_rounds += fused["rounds"]
             strategy.fused_syncs += fused["syncs"]
             strategy.fused_k_samples.extend(fused["k_samples"])
+            strategy.mesh_steal_events += fused.get("steal_events", 0)
+            strategy.mesh_steal_lanes += fused.get("steal_lanes", 0)
+            if fused.get("occupancy"):
+                strategy.mesh_occupancy = list(fused["occupancy"])
             if job_ctx is not None and fused["rounds"]:
                 # S1: a K-fused super-round must not silently widen the
                 # checkpoint cadence — credit the journal so the next

@@ -1289,6 +1289,20 @@ def _byte_length(w):
     return jnp.where(any_nz, 2 * h + dbytes, 0).astype(U32)
 
 
+def op_hist_update(cb: CodeBank, before: StateBatch, after: StateBatch, hist):
+    """Fold one step into the retired-opcode histogram (u32[257-capped]).
+
+    Derived purely from observable state — a lane retired ``code[pc]``
+    iff its step counter advanced; index 256 absorbs stalled lanes and
+    is dropped by the scatter. Shared by the slice loop here and the
+    fused megakernel round body (single + mesh), so all three stats
+    paths count retirement identically."""
+    CL = cb.code.shape[1]
+    op = cb.code[before.code_id, jnp.clip(before.pc, 0, CL - 1)].astype(I32)
+    idx = jnp.where(after.steps > before.steps, op, 256)  # 256 = dropped
+    return hist.at[idx].add(1, mode="drop")
+
+
 @partial(
     jax.jit, static_argnames=("max_steps", "with_stats"), donate_argnames=("st",)
 )
@@ -1308,7 +1322,6 @@ def _run_impl(
     equivalent). Derived purely from observable state (a lane retired
     code[pc] iff its step counter advanced), so the step kernel itself
     stays unchanged. One body, two jit specializations."""
-    CL = cb.code.shape[1]
 
     def cond(carry):
         t, s, _hist = carry
@@ -1318,9 +1331,7 @@ def _run_impl(
         t, s, hist = carry
         ns = step(cb, env, s)
         if with_stats:
-            op = cb.code[s.code_id, jnp.clip(s.pc, 0, CL - 1)].astype(I32)
-            idx = jnp.where(ns.steps > s.steps, op, 256)  # 256 = dropped
-            hist = hist.at[idx].add(1, mode="drop")
+            hist = op_hist_update(cb, s, ns, hist)
         return t + 1, ns, hist
 
     hist0 = jnp.zeros((256 if with_stats else 1,), jnp.uint32)

@@ -44,13 +44,15 @@ the alive frontier a dense prefix, which later forks refill and the
 host download can slice.
 """
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from mythril_tpu import obs
+from mythril_tpu.laser.tpu import mesh as mesh_lib
 from mythril_tpu.laser.tpu.batch import (
     RUNNING,
     REVERTED,
@@ -59,7 +61,7 @@ from mythril_tpu.laser.tpu.batch import (
     Env,
     StateBatch,
 )
-from mythril_tpu.laser.tpu.engine import step
+from mythril_tpu.laser.tpu.engine import op_hist_update, step
 
 I32 = jnp.int32
 
@@ -108,6 +110,55 @@ def compact_impl(st: StateBatch) -> StateBatch:
 compact = jax.jit(compact_impl, donate_argnames=("st",))
 
 
+def _one_round(
+    cb: CodeBank,
+    env: Env,
+    s: StateBatch,
+    hist,
+    pl,
+    ps,
+    px,
+    pv,
+    steps_per_round: int,
+    with_stats: bool,
+):
+    """One fused round: step ``steps_per_round`` times, REVERT-prune
+    (folding the dying lanes' counters into the accumulators), compact.
+
+    Shared verbatim by the single-device megakernel and the shard_map
+    mesh body — on a lane-sharded batch every op here is lane-local, so
+    GSPMD/shard_map partition it with zero communication."""
+
+    def one_step(_, inner):
+        s2, h = inner
+        ns = step(cb, env, s2)
+        if with_stats:
+            h = op_hist_update(cb, s2, ns, h)
+        return ns, h
+
+    s, hist = jax.lax.fori_loop(0, steps_per_round, one_step, (s, hist))
+
+    # prune: fold the dying lanes' observable counters into the
+    # carry accumulators before the kill — the host merges them so
+    # steps/coverage/static-prune accounting matches the lift path
+    dead = prune_mask(cb, s)
+    pl = pl + jnp.sum(dead.astype(I32))
+    ps = ps + jnp.sum(jnp.where(dead, s.steps, 0))
+    px = px + jnp.sum(jnp.where(dead, s.static_pruned, 0))
+    pv = pv.at[s.code_id].max(dead[:, None] & s.visited)
+    # zero the dying lanes' counter planes: the host sums steps/
+    # static_pruned over ALL lanes, so a stale copy left in a free
+    # lane would double-count against the accumulators above
+    s = s._replace(
+        alive=s.alive & ~dead,
+        steps=jnp.where(dead, 0, s.steps),
+        static_pruned=jnp.where(dead, 0, s.static_pruned),
+        visited=jnp.where(dead[:, None], False, s.visited),
+    )
+    s = compact_impl(s)
+    return s, hist, pl, ps, px, pv
+
+
 @partial(
     jax.jit,
     static_argnames=("steps_per_round", "with_stats"),
@@ -124,7 +175,6 @@ def _fused_impl(
     """The megakernel body. ``max_rounds`` is TRACED (a runtime scalar),
     so the adaptive-K controller never triggers a recompile; only
     ``steps_per_round``/``with_stats`` specialize the kernel."""
-    CL = cb.code.shape[1]
     n_codes = cb.code.shape[0]
     W = st.visited.shape[1]
 
@@ -136,38 +186,10 @@ def _fused_impl(
 
     def body(carry):
         r, s, pl, ps, px, pv, hist = carry
-
-        def one_step(_, inner):
-            s2, h = inner
-            ns = step(cb, env, s2)
-            if with_stats:
-                op = cb.code[s2.code_id, jnp.clip(s2.pc, 0, CL - 1)].astype(
-                    I32
-                )
-                idx = jnp.where(ns.steps > s2.steps, op, 256)  # 256 = dropped
-                h = h.at[idx].add(1, mode="drop")
-            return ns, h
-
-        s, hist = jax.lax.fori_loop(0, steps_per_round, one_step, (s, hist))
-
-        # prune: fold the dying lanes' observable counters into the
-        # carry accumulators before the kill — the host merges them so
-        # steps/coverage/static-prune accounting matches the lift path
-        dead = prune_mask(cb, s)
-        pl = pl + jnp.sum(dead.astype(I32))
-        ps = ps + jnp.sum(jnp.where(dead, s.steps, 0))
-        px = px + jnp.sum(jnp.where(dead, s.static_pruned, 0))
-        pv = pv.at[s.code_id].max(dead[:, None] & s.visited)
-        # zero the dying lanes' counter planes: the host sums steps/
-        # static_pruned over ALL lanes, so a stale copy left in a free
-        # lane would double-count against the accumulators above
-        s = s._replace(
-            alive=s.alive & ~dead,
-            steps=jnp.where(dead, 0, s.steps),
-            static_pruned=jnp.where(dead, 0, s.static_pruned),
-            visited=jnp.where(dead[:, None], False, s.visited),
+        s, hist, pl, ps, px, pv = _one_round(
+            cb, env, s, hist, pl, ps, px, pv,
+            steps_per_round=steps_per_round, with_stats=with_stats,
         )
-        s = compact_impl(s)
         return r + 1, s, pl, ps, px, pv, hist
 
     zero = jnp.asarray(0, I32)
@@ -233,3 +255,160 @@ def decode_info(info) -> FusedStats:
         n_alive=int(vals[4]),
         n_running=int(vals[5]),
     )
+
+
+# ---------------------------------------------------------------------------
+# fused MESH path: the same super-round under shard_map, with on-device
+# ICI work-stealing between rounds (docs/MESH.md)
+# ---------------------------------------------------------------------------
+
+_AX = "paths"
+
+
+class MeshFusedStats(NamedTuple):
+    """Host-side decode of the fused-MESH info vector (i32[8 + n_shards]).
+
+    The first six fields mirror :class:`FusedStats`; the steal counters
+    and the per-shard frontier occupancy ride the SAME vector, so steal
+    accounting and occupancy gauges cost zero extra host syncs (the
+    whole point of folding them into ``info``)."""
+
+    rounds: int
+    pruned_lanes: int
+    pruned_steps: int
+    pruned_static: int
+    n_alive: int
+    n_running: int
+    steal_events: int
+    steal_lanes: int
+    occupancy: tuple  # per-shard running lanes at loop exit
+
+
+def decode_mesh_info(info, n_shards: int) -> MeshFusedStats:
+    """ONE blocking device->host fetch for all fused-mesh scalars."""
+    import numpy as np
+
+    vals = np.asarray(info)  # noqa: device_loop_purity — host-side decode
+    return MeshFusedStats(
+        rounds=int(vals[0]),
+        pruned_lanes=int(vals[1]),
+        pruned_steps=int(vals[2]),
+        pruned_static=int(vals[3]),
+        n_alive=int(vals[4]),
+        n_running=int(vals[5]),
+        steal_events=int(vals[6]),
+        steal_lanes=int(vals[7]),
+        occupancy=tuple(int(v) for v in vals[8 : 8 + n_shards]),
+    )
+
+
+@lru_cache(maxsize=None)
+def _mesh_kernel(mesh, steps_per_round: int, with_stats: bool):
+    """Compile the fused super-round for one mesh shape.
+
+    The whole megakernel loop runs INSIDE ``shard_map``: every shard
+    owns a contiguous lane block (StateBatch planes sharded on the
+    leading axis, CodeBank/env replicated), the round body is the exact
+    single-device ``_one_round`` (lane-local, zero communication), and
+    the only collectives are deliberate — the psum quiescence check in
+    the loop cond, and the steal_plan/steal_apply all-gather +
+    all-to-all between rounds. Keyed on the (hashable, cached) Mesh so
+    repeated dispatches reuse one executable; ``max_rounds`` stays
+    traced exactly as on the single-device path."""
+    from jax.experimental.shard_map import shard_map
+
+    n = mesh.devices.size
+
+    def shard_body(cb, env, st, max_rounds):
+        n_codes = cb.code.shape[0]
+        W = st.visited.shape[1]
+
+        def cond(carry):
+            r, s, *_rest = carry
+            local = jnp.any(s.alive & (s.status == RUNNING)).astype(I32)
+            # quiescence is GLOBAL: a drained shard keeps serving steal
+            # collectives until the whole mesh frontier is empty (every
+            # shard must iterate in lockstep for the all-to-alls)
+            return (r < max_rounds) & (jax.lax.psum(local, _AX) > 0)
+
+        def body(carry):
+            r, s, pl, ps, px, pv, hist, sev, sln = carry
+            s, hist, pl, ps, px, pv = _one_round(
+                cb, env, s, hist, pl, ps, px, pv,
+                steps_per_round=steps_per_round, with_stats=with_stats,
+            )
+            # work-steal between rounds: the plan is derived from one
+            # tiny all-gather, identical on every shard, so the cond
+            # predicate is mesh-uniform and the all-to-all inside the
+            # taken branch executes on all shards or none
+            plan = mesh_lib.steal_plan(s, n, axis=_AX)
+            spread = jnp.max(plan.occ) - jnp.min(plan.occ)
+            do_steal = (plan.moved > 0) & (spread > 1)
+
+            def _steal(s_):
+                return compact_impl(mesh_lib.steal_apply(s_, plan, n, axis=_AX))
+
+            s = jax.lax.cond(do_steal, _steal, lambda s_: s_, s)
+            sev = sev + do_steal.astype(I32)
+            sln = sln + jnp.where(do_steal, plan.moved, 0)
+            return r + 1, s, pl, ps, px, pv, hist, sev, sln
+
+        zero = jnp.asarray(0, I32)
+        hist0 = jnp.zeros((256 if with_stats else 1,), jnp.uint32)
+        pv0 = jnp.zeros((n_codes, W), jnp.bool_)
+        r, out, pl, ps, px, pv, hist, sev, sln = jax.lax.while_loop(
+            cond, body, (zero, st, zero, zero, zero, pv0, hist0, zero, zero)
+        )
+
+        # fold the per-shard accumulators into mesh-wide replicated
+        # outputs; occupancy rides the same info vector (zero extra
+        # host syncs for gauges/steal gating)
+        running = out.alive & (out.status == RUNNING)
+        occ = jax.lax.all_gather(jnp.sum(running.astype(I32)), _AX)
+        n_alive = jax.lax.psum(jnp.sum(out.alive.astype(I32)), _AX)
+        pl = jax.lax.psum(pl, _AX)
+        ps = jax.lax.psum(ps, _AX)
+        px = jax.lax.psum(px, _AX)
+        pv = jax.lax.psum(pv.astype(jnp.uint32), _AX) > 0
+        hist = jax.lax.psum(hist, _AX)
+        info = jnp.concatenate(
+            [jnp.stack([r, pl, ps, px, n_alive, jnp.sum(occ), sev, sln]), occ]
+        )
+        return FusedOut(st=out, info=info, pruned_visited=pv, hist=hist)
+
+    sm = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(), P(_AX), P()),
+        out_specs=FusedOut(st=P(_AX), info=P(), pruned_visited=P(), hist=P()),
+        check_rep=False,
+    )
+    return jax.jit(sm, donate_argnums=(2,))
+
+
+def run_fused_mesh(
+    mesh,
+    cb: CodeBank,
+    env: Env,
+    st: StateBatch,
+    max_rounds: int,
+    steps_per_round: int = 512,
+    with_stats: bool = False,
+) -> FusedOut:
+    """Dispatch one fused MESH super-round (sharded ``st``, replicated
+    ``cb``/``env``). As on the single-device path, nothing here blocks —
+    the caller owns the single ``info`` fetch (``decode_mesh_info``)."""
+    n = mesh.devices.size
+    if st.pc.shape[0] % n != 0:
+        raise ValueError(
+            f"lane count {st.pc.shape[0]} not divisible by mesh size {n}"
+        )
+    with obs.TRACER.span(
+        "fused_super_round",
+        tid="device",
+        max_rounds=int(max_rounds),
+        steps_per_round=steps_per_round,
+        shards=n,
+    ):
+        fn = _mesh_kernel(mesh, steps_per_round, bool(with_stats))  # noqa: host-side cache key normalization
+        return fn(cb, env, st, jnp.asarray(int(max_rounds), I32))

@@ -16,9 +16,20 @@ Device placement: one mesh axis ``'paths'``; multi-host meshes extend the
 same axis over DCN. Tests exercise this on a virtual 8-device CPU mesh
 (tests/conftest.py), and __graft_entry__.dryrun_multichip compiles and
 runs the full sharded round end-to-end.
+
+Two tiers consume this module (docs/MESH.md):
+
+  * the FUSED mesh path (megakernel.run_fused_mesh) runs the whole
+    super-round inside ``shard_map`` and calls :func:`steal_plan` /
+    :func:`steal_apply` between rounds — an explicit ICI all-to-all
+    work-steal that never leaves the device;
+  * the SYNC degrade tier (backend ``_run_device``) keeps the legacy
+    one-round-per-dispatch loop, gated by the device-computed occupancy
+    vector ``round_impl`` now returns (no extra host fetch).
 """
 
-from typing import Optional
+from functools import lru_cache
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,11 +39,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mythril_tpu.laser.tpu.batch import RUNNING, CodeBank, Env, StateBatch
 from mythril_tpu.laser.tpu.engine import step
 
+I32 = jnp.int32
+
+
+@lru_cache(maxsize=None)
+def _mesh_cached(n: int) -> Mesh:
+    devs = jax.devices()
+    return Mesh(np.array(devs[:n]), ("paths",))  # noqa: host-side mesh setup
+
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
-    devs = jax.devices()
-    n = len(devs) if n_devices is None else n_devices
-    return Mesh(np.array(devs[:n]), ("paths",))
+    """The 1-D ``'paths'`` mesh over the first ``n_devices`` devices.
+
+    Cached per size: the fused-mesh kernel cache (megakernel) is keyed on
+    the Mesh object, so handing back the same instance keeps one compile
+    per (shape, steps_per_round) instead of one per call site."""
+    n = len(jax.devices()) if n_devices is None else n_devices
+    return _mesh_cached(n)
 
 
 def path_sharding(mesh: Mesh) -> NamedSharding:
@@ -81,13 +104,139 @@ def rebalance(st: StateBatch, n_shards: int = 1) -> StateBatch:
     return jax.tree_util.tree_map(permute, st)
 
 
+class StealPlan(NamedTuple):
+    """Device-computed ICI work-steal schedule (one per super-round).
+
+    Built inside a ``shard_map`` body from ONE small ``all_gather`` of
+    per-shard [running, alive] counts — every shard derives the identical
+    global schedule, so no further negotiation collective is needed."""
+
+    export: jnp.ndarray  # bool[per]  lanes this shard donates
+    buf_pos: jnp.ndarray  # i32[per]  exchange-buffer row (dest*per + slot)
+    filled: jnp.ndarray  # i32[n]    lanes each shard imports
+    occ: jnp.ndarray  # i32[n]    running lanes per shard (pre-steal)
+    alive_c: jnp.ndarray  # i32[n]    alive lanes per shard (pre-steal)
+    moved: jnp.ndarray  # i32[]     total lanes moved mesh-wide
+
+
+def steal_plan(st: StateBatch, n_shards: int, axis: str = "paths") -> StealPlan:
+    """Plan the lane rebalance for one shard (call inside shard_map).
+
+    Matching is by global prefix sums: donor shard ``d`` exports its
+    surplus running lanes (those past its fair-share target, taken from
+    the dense compacted tail) to global donor indices
+    ``donor_base[d]..``; receiver shard ``r`` absorbs global indices
+    ``recv_base[r]..recv_base[r]+deficit[r]`` into its free suffix.
+    Both bases are exclusive cumsums of the gathered occupancy vector,
+    so the schedule is a pure function of ``occ``/``alive_c`` and every
+    shard computes the same one."""
+    per = st.pc.shape[0]
+    running = st.alive & (st.status == RUNNING)
+    n_run = jnp.sum(running.astype(I32))
+    n_alv = jnp.sum(st.alive.astype(I32))
+    counts = jax.lax.all_gather(jnp.stack([n_run, n_alv]), axis)  # [n, 2]
+    occ = counts[:, 0]
+    alive_c = counts[:, 1]
+    free = per - alive_c
+    total = jnp.sum(occ)
+    base = total // n_shards
+    rem = total - base * n_shards
+    target = base + (jnp.arange(n_shards, dtype=I32) < rem).astype(I32)
+    surplus = jnp.maximum(occ - target, 0)
+    # a starved shard can only absorb into lanes it has free
+    deficit = jnp.minimum(jnp.maximum(target - occ, 0), free)
+    moved = jnp.minimum(jnp.sum(surplus), jnp.sum(deficit))
+    donor_base = jnp.cumsum(surplus) - surplus  # exclusive prefix
+    recv_base = jnp.cumsum(deficit) - deficit
+    recv_end = jnp.cumsum(deficit)
+    filled = jnp.clip(moved - recv_base, 0, deficit)
+
+    me = jax.lax.axis_index(axis)
+    keep = occ[me] - surplus[me]
+    rank = jnp.cumsum(running.astype(I32)) - 1  # rank among running lanes
+    gidx = donor_base[me] + rank - keep  # global donor index
+    export = running & (rank >= keep) & (gidx < moved)
+    dest = jnp.searchsorted(recv_end, gidx, side="right").astype(I32)
+    dest = jnp.minimum(dest, n_shards - 1)
+    slot = gidx - recv_base[dest]
+    buf_pos = jnp.where(export, dest * per + slot, n_shards * per)
+    return StealPlan(
+        export=export,
+        buf_pos=buf_pos,
+        filled=filled,
+        occ=occ,
+        alive_c=alive_c,
+        moved=moved,
+    )
+
+
+def steal_apply(
+    st: StateBatch, plan: StealPlan, n_shards: int, axis: str = "paths"
+) -> StateBatch:
+    """Execute the planned ICI all-to-all lane exchange (inside shard_map).
+
+    Every plane rides one ``lax.all_to_all``: donors scatter exported
+    lanes into a dense [n*per] exchange buffer (row ``dest*per + slot``),
+    the collective swaps per-destination blocks, and receivers fold the
+    n incoming blocks (at most one sender per slot, so sum/any merges
+    exactly). Exported lanes are killed locally with their counter
+    planes zeroed — the host sums ``steps``/``static_pruned``/``visited``
+    over ALL lanes, and the moved copy now owns those counters. Imports
+    land in the receiver's free suffix; the result is NOT re-compacted
+    (the caller's round loop compacts next)."""
+    per = st.pc.shape[0]
+    cap = n_shards * per
+    pos = plan.buf_pos
+
+    def exchange(x):
+        buf = jnp.zeros((cap,) + x.shape[1:], x.dtype)
+        buf = buf.at[pos].set(x, mode="drop")
+        recv = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
+        blocks = recv.reshape((n_shards, per) + x.shape[1:])
+        if blocks.dtype == jnp.bool_:
+            return jnp.any(blocks, axis=0)
+        return jnp.sum(blocks, axis=0, dtype=blocks.dtype)
+
+    incoming = jax.tree_util.tree_map(exchange, st)
+
+    ex = plan.export
+    st = st._replace(
+        alive=st.alive & ~ex,
+        steps=jnp.where(ex, 0, st.steps),
+        static_pruned=jnp.where(ex, 0, st.static_pruned),
+        visited=jnp.where(ex[:, None], False, st.visited),
+    )
+
+    me = jax.lax.axis_index(axis)
+    n_in = plan.filled[me]
+    start = plan.alive_c[me]
+    j = jnp.arange(per, dtype=I32)
+    slot = jnp.where(j < n_in, start + j, per)  # per == OOB -> dropped
+
+    def place(local, inc):
+        return local.at[slot].set(inc, mode="drop")
+
+    return jax.tree_util.tree_map(place, st, incoming)
+
+
 def occupancy(st: StateBatch, n_shards: int) -> np.ndarray:
     """Per-shard running-lane counts (host-side rebalance gating)."""
-    running = np.asarray(st.alive & (st.status == RUNNING))
+    running = np.asarray(st.alive & (st.status == RUNNING))  # noqa: host decode
     if running.shape[0] % n_shards != 0:
         raise ValueError(
             f"lane count {running.shape[0]} not divisible by n_shards {n_shards}"
         )
+    return running.reshape(n_shards, -1).sum(axis=1)
+
+
+def occupancy_impl(st: StateBatch, n_shards: int) -> jnp.ndarray:
+    """Device-side per-shard running-lane counts (i32[n_shards]).
+
+    The lane axis is shard-major (contiguous per-device blocks), so a
+    reshape-sum gives the per-shard frontier without any host traffic —
+    this is what ``round_impl`` folds into its return value so the sync
+    loop's steal gating costs zero extra fetches."""
+    running = (st.alive & (st.status == RUNNING)).astype(I32)
     return running.reshape(n_shards, -1).sum(axis=1)
 
 
@@ -98,12 +247,25 @@ def should_rebalance(st: StateBatch, n_shards: int) -> bool:
     threshold" — an unconditional all-to-all every round wastes ICI. A
     perfect deal leaves max-min <= 1, so fire only when the current
     spread is worse than that (rebalance() couldn't improve otherwise).
+
+    NOTE: this fetches the alive plane (one blocking host sync). The
+    round loop should prefer :func:`should_rebalance_occ` on the
+    occupancy vector the previous ``round_impl`` dispatch already
+    returned — that costs zero extra syncs.
     """
     L = st.pc.shape[0]
     if n_shards < 2 or L % n_shards != 0:
         return False
     occ = occupancy(st, n_shards)
     if occ.sum() == 0:
+        return False
+    return int(occ.max()) - int(occ.min()) > 1
+
+
+def should_rebalance_occ(occ) -> bool:
+    """should_rebalance() on an already-fetched occupancy vector."""
+    occ = np.asarray(occ)  # noqa: host decode of a fetched vector
+    if occ.shape[0] < 2 or occ.sum() == 0:
         return False
     return int(occ.max()) - int(occ.min()) > 1
 
@@ -115,14 +277,20 @@ def round_impl(
     steps_per_round: int = 64,
     do_rebalance: bool = False,
     n_shards: int = 1,
-) -> StateBatch:
+):
     """One distributed round: local lockstep stepping, then rebalance.
 
-    This is the jitted unit the driver dry-runs multi-chip: lane-local
-    compute partitions cleanly; the trailing rebalance is the collective.
-    Rebalancing is opt-in: pass do_rebalance=True AND n_shards>=2 (it is
-    a deliberate cross-device permutation, and a no-op on one shard).
-    Gate rounds host-side with should_rebalance() to avoid wasting ICI.
+    This is the jitted unit of the SYNC degrade tier (and the driver's
+    multi-chip dry-run): lane-local compute partitions cleanly; the
+    trailing rebalance is the collective. Rebalancing is opt-in: pass
+    do_rebalance=True AND n_shards>=2 (it is a deliberate cross-device
+    permutation, and a no-op on one shard).
+
+    Returns ``(st, occ)`` with ``occ = i32[n_shards]`` per-shard running
+    counts computed ON DEVICE after the round — the host gates the next
+    round's rebalance (``should_rebalance_occ``) and detects quiescence
+    (``occ.sum() == 0``) from this one tiny fetch instead of pulling the
+    full alive plane every round.
     """
     if do_rebalance and n_shards < 2:
         raise ValueError("do_rebalance=True requires n_shards >= 2")
@@ -138,7 +306,8 @@ def round_impl(
     _, out = jax.lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), st))
     if do_rebalance:
         out = rebalance(out, n_shards)
-    return out
+    occ = occupancy_impl(out, max(1, n_shards))
+    return out, occ
 
 
 sharded_round = jax.jit(

@@ -278,7 +278,7 @@ def _unpack_host(buf: np.ndarray, shapes) -> dict:
     return planes
 
 
-def batch_to_host(st: StateBatch) -> StateBatch:
+def batch_to_host(st: StateBatch, n_shards: int = 1) -> StateBatch:
     """Device StateBatch -> StateBatch of numpy planes in two downloads.
 
     Fetch 1 moves the small bookkeeping planes (including ``tape_len``);
@@ -287,6 +287,11 @@ def batch_to_host(st: StateBatch) -> StateBatch:
     the full batch. ``np.asarray`` on the result's fields is free, so
     everything downstream of a device round (lift/unpack, coverage, step
     counters) reads this view without further transfers.
+
+    ``n_shards > 1`` declares the batch came off the mesh path, where
+    compaction is PER SHARD (each contiguous lane block keeps its own
+    dense alive prefix) — the bulky planes then ship one lane bucket per
+    shard block instead of full height.
     """
     faults.fire(faults.TRANSFER_DOWN, context="batch_to_host")
     small = tuple(
@@ -303,6 +308,7 @@ def batch_to_host(st: StateBatch) -> StateBatch:
     cap = int(st.tape_op.shape[1])
     L = int(st.alive.shape[0])
     l_used = None
+    shard_lanes = None
     if monomorphic():
         t_used = cap
     else:
@@ -313,13 +319,27 @@ def batch_to_host(st: StateBatch) -> StateBatch:
         # are never read by the lift/harvest consumers, so only a lane
         # bucket over the prefix ships. The prefix property is VERIFIED
         # from the already-fetched alive plane (an uncompacted batch —
-        # legacy slice loop, mesh — simply ships full-height).
+        # legacy slice loop — simply ships full-height).
         alive = planes["alive"]
         n_alive = int(alive.sum())
         if n_alive < L and not alive[n_alive:].any():
             lb = _lane_bucket(n_alive, L)
             if lb < L:
                 l_used = lb
+        elif n_shards > 1 and L % n_shards == 0:
+            # mesh variant: the shard_map compaction leaves one dense
+            # prefix per contiguous shard block; verify each block and
+            # ship a common per-shard bucket sized by the fullest shard
+            per = L // n_shards
+            blocks = alive.reshape(n_shards, per)
+            counts = blocks.sum(axis=1)
+            dense = all(
+                not blocks[s, int(c):].any() for s, c in enumerate(counts)
+            )
+            if dense:
+                lb = _lane_bucket(int(counts.max()), per)
+                if lb < per:
+                    shard_lanes = (n_shards, lb)
     big_shapes = []
     for f in _BIG_DOWN:
         dev = getattr(st, f)
@@ -328,10 +348,14 @@ def batch_to_host(st: StateBatch) -> StateBatch:
             shape = (shape[0], _tape_cols(f, t_used)) + shape[2:]
         if l_used is not None:
             shape = (l_used,) + shape[1:]
+        elif shard_lanes is not None:
+            shape = (shard_lanes[0] * shard_lanes[1],) + shape[1:]
         big_shapes.append((f, shape, np.dtype(dev.dtype)))
     planes.update(
         _unpack_host(
-            np.asarray(_flatten_device(st, _BIG_DOWN, t_used, l_used)),
+            np.asarray(
+                _flatten_device(st, _BIG_DOWN, t_used, l_used, shard_lanes)
+            ),
             big_shapes,
         )
     )
@@ -346,21 +370,34 @@ def batch_to_host(st: StateBatch) -> StateBatch:
             full[:, : planes[f].shape[1]] = planes[f]
             planes[f] = full
     # pad lane-sliced planes back to full height (dead-suffix lanes are
-    # equivalent to zeros for every host consumer)
+    # equivalent to zeros for every host consumer); per-shard buckets go
+    # back to their block's original offset
     if l_used is not None:
         for f in _BIG_DOWN:
             if planes[f].shape[0] != L:
                 full = np.zeros((L,) + planes[f].shape[1:], planes[f].dtype)
                 full[: planes[f].shape[0]] = planes[f]
                 planes[f] = full
+    elif shard_lanes is not None:
+        n, lb = shard_lanes
+        per = L // n
+        for f in _BIG_DOWN:
+            got = planes[f]
+            full = np.zeros((L,) + got.shape[1:], got.dtype)
+            for s in range(n):
+                full[s * per : s * per + lb] = got[s * lb : (s + 1) * lb]
+            planes[f] = full
     for name in _SKIP_DOWN:
         dev = getattr(st, name)
         planes[name] = np.zeros(dev.shape, dev.dtype)
     return StateBatch(**planes)
 
 
-@partial(jax.jit, static_argnames=("fields", "t_used", "l_used"))
-def _flatten_device(st: StateBatch, fields, t_used=None, l_used=None):
+@partial(
+    jax.jit, static_argnames=("fields", "t_used", "l_used", "shard_lanes")
+)
+def _flatten_device(st: StateBatch, fields, t_used=None, l_used=None,
+                    shard_lanes=None):
     parts = []
     for name in fields:
         x = getattr(st, name)
@@ -368,6 +405,12 @@ def _flatten_device(st: StateBatch, fields, t_used=None, l_used=None):
             x = x[:, : _tape_cols(name, t_used)]
         if l_used is not None:
             x = x[:l_used]
+        elif shard_lanes is not None:
+            n, lb = shard_lanes
+            per = x.shape[0] // n
+            x = x.reshape((n, per) + x.shape[1:])[:, :lb].reshape(
+                (n * lb,) + x.shape[1:]
+            )
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.uint8)
         if x.dtype.itemsize > 1:

@@ -57,6 +57,24 @@ CNF_CLAUSES_TOTAL = REGISTRY.counter(
     "myth_cnf_clauses_total", "CNF clauses blasted for device dispatch"
 )
 
+# -- fused mesh path (laser/tpu/mesh.py, backend._run_mesh_fused) ------
+
+# last observed running-lane count per shard, set from the fused info
+# vector after every mesh super-round (no extra device fetch)
+MESH_FRONTIER_OCCUPANCY = REGISTRY.gauge(
+    "myth_mesh_frontier_occupancy_total",
+    "running lanes resident on one mesh shard after the last super-round",
+    labelnames=("shard",),
+)
+MESH_STEAL_EVENTS_TOTAL = REGISTRY.counter(
+    "myth_mesh_steal_events_total",
+    "ICI work-steal exchanges fired between fused mesh rounds",
+)
+MESH_STEAL_LANES_TOTAL = REGISTRY.counter(
+    "myth_mesh_steal_lanes_total",
+    "lanes moved across shards by ICI work-steal exchanges",
+)
+
 # -- robustness (robustness/retry.py, faults.py, checkpoint.py) --------
 
 DEVICE_RETRIES_TOTAL = REGISTRY.counter(

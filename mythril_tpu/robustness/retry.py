@@ -173,15 +173,19 @@ def run_round_guarded(bridge, cfg, *, want_stats=False, deadline=None,
 
     ``fused_k`` is the super-round depth the stepping loop plans to run
     (default: asked from the backend). The watchdog deadline scales by
-    it — ``ROUND_WATCHDOG_S * fused_k`` — and is folded into the
-    caller's ``deadline``, so a K-fused round gets K rounds' budget
-    instead of tripping the single-round clamp.
+    it — ``ROUND_WATCHDOG_S * fused_k`` — times the backend's planned
+    mesh factor (fused mesh super-rounds additionally pay per-round
+    collective latency), and is folded into (never past) the caller's
+    ``deadline``, so a K-fused round gets K rounds' budget instead of
+    tripping the single-round clamp.
     """
     from mythril_tpu.laser.tpu import backend, transfer
 
     if fused_k is None:
         fused_k = backend.planned_fused_k()
-    watchdog_s = ROUND_WATCHDOG_S * max(1, int(fused_k))
+    watchdog_s = (
+        ROUND_WATCHDOG_S * max(1, int(fused_k)) * backend.planned_mesh_factor()
+    )
     attempts = 1 + DEVICE_MAX_RETRIES
     delay = BACKOFF_BASE_S
     last = None
@@ -208,7 +212,11 @@ def run_round_guarded(bridge, cfg, *, want_stats=False, deadline=None,
                 )
             device_wall = time.time() - t0
             with obs.phase("transfer_down"):
-                out = transfer.batch_to_host(out)
+                # mesh rounds compact per shard, so the download bucket
+                # is per-shard too (set by _run_device on the bridge)
+                out = transfer.batch_to_host(
+                    out, n_shards=getattr(bridge, "mesh_n_shards", 1)
+                )
             BREAKER.record_success()
             return out, op_hist, device_wall
         except Exception as e:

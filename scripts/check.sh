@@ -105,4 +105,15 @@ python -m pytest tests/laser/test_megakernel.py \
     -q -p no:cacheprovider \
     -k "smoke or compact_basic or prune_mask"
 
+echo "== virtual-mesh smoke =="
+# fused MESH path on the 8-virtual-CPU-device mesh (conftest supplies
+# the devices), fused tier forced on: the steal plan/apply invariants
+# through a real shard_map all-to-all, one skewed-fork run of the fused
+# mesh megakernel (ICI steal fires in-loop), and the tier policy table.
+# The mesh-vs-single-device equivalence property tests run with the
+# full suite; -k trims to the steal/policy half.
+MYTHRIL_TPU_MESH=on python -m pytest tests/laser/test_mesh_fused.py \
+    -q -p no:cacheprovider \
+    -k "steal or tier or planned"
+
 echo "ALL CHECKS PASSED"

@@ -569,6 +569,7 @@ def metric_names(tree: ast.AST, source: str, rel: str):
 _DEVICE_PURE_FILES = {
     "mythril_tpu/laser/tpu/engine.py",
     "mythril_tpu/laser/tpu/megakernel.py",
+    "mythril_tpu/laser/tpu/mesh.py",
 }
 
 _HOST_CALLBACK_NAMES = {

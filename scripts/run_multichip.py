@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""MULTICHIP acceptance harness: the fused mesh path on a virtual
+8-device CPU mesh (docs/MESH.md).
+
+Three measurements, one JSON artifact (MULTICHIP_r06.json):
+
+1. **pipeline equivalence** — the full product pipeline (SymExec +
+   fire_lasers) over the becstress and BECToken bench contracts, once
+   with the mesh forced OFF (single-device fused megakernel) and once
+   forced ON (shard_map fused mesh with ICI work-stealing). Acceptance:
+   identical issue sets.
+2. **skewed-fork steal demo** — a frontier concentrated on 2 of 8
+   shards, run through megakernel.run_fused_mesh. Acceptance: >= 1
+   steal fires in-loop, and the recorded per-shard frontier occupancy
+   is balanced (spread <= 1).
+3. **mesh counters through the strategy** — the mesh-on pipeline run's
+   steal_events / steal_volume_lanes / frontier_occupancy as surfaced
+   by TpuBatchStrategy (the same fields bench.py emits).
+
+Run from the repo root: python scripts/run_multichip.py
+"""
+
+import json
+import os
+import sys
+import time
+
+N_DEVICES = 8
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={N_DEVICES}"
+).strip()
+
+import __graft_entry__  # noqa: E402
+
+__graft_entry__._force_cpu_platform()
+
+
+def _phase(msg):
+    print(f"multichip[{time.strftime('%H:%M:%S')}]: {msg}", flush=True)
+
+
+def _analyze(creation_hex, runtime_hex, name, tx, budget_s):
+    """One pipeline run; returns (issue set, mesh counter dict)."""
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+    from mythril_tpu.laser.tpu import backend
+    from mythril_tpu.laser.tpu.backend import find_tpu_strategy
+
+    # compile the selected tier's kernels before the execution-timeout
+    # clock starts (the tier reads MYTHRIL_TPU_MESH, so warm up AFTER
+    # the caller set the arm's env) — otherwise XLA compile latency
+    # eats the budget and both arms under-explore. warmup_device caches
+    # on (cfg, want_stats) only, so the second arm's call is a no-op;
+    # one direct empty-batch _run_device compiles whichever loop THIS
+    # arm's tier selects (cheap when already compiled).
+    import numpy as np
+
+    from mythril_tpu.laser.tpu import transfer
+    from mythril_tpu.laser.tpu.batch import batch_shapes, make_code_bank
+
+    cfg = backend.DEFAULT_BATCH_CFG
+    backend.warmup_device(cfg)
+    np_batch = {
+        field: np.zeros(shape, dtype)
+        for field, (shape, dtype) in batch_shapes(cfg).items()
+    }
+    warm_st = transfer.batch_to_device(np_batch, cfg)
+    warm_cb = make_code_bank(
+        [b"\x00"], cfg.code_len, host_ops=(), freeze_errors=True
+    )
+    backend._run_device(warm_cb, warm_st, cfg, want_stats=False)
+
+    contract = EVMContract(
+        code=runtime_hex, creation_code=creation_hex, name=name
+    )
+    sym = SymExecWrapper(
+        contract,
+        address=0x1234,
+        strategy="tpu-batch",
+        execution_timeout=budget_s,
+        transaction_count=tx,
+        max_depth=128,
+    )
+    issues = sorted({(i.swc_id, i.address) for i in fire_lasers(sym)})
+    strategy = find_tpu_strategy(sym.laser.strategy)
+    mesh = {}
+    if strategy is not None:
+        mesh = {
+            "steal_events": strategy.mesh_steal_events,
+            "steal_volume_lanes": strategy.mesh_steal_lanes,
+            "frontier_occupancy": list(strategy.mesh_occupancy),
+            "fused_rounds": strategy.fused_rounds,
+            "fused_syncs": strategy.fused_syncs,
+        }
+    return issues, mesh
+
+
+def _contracts():
+    import bench
+    from mythril_tpu.disassembler.asm import assemble
+
+    out = []
+    runtime = assemble(bench.STRESS_SRC)
+    n = len(runtime)
+    creation = (
+        assemble(
+            f"PUSH2 {n}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\n"
+            f"PUSH2 {n}\nPUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + runtime.hex()
+    )
+    out.append(("becstress", creation, runtime.hex(), 2, 60))
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bec_src = open(os.path.join(root, "bench_contracts", "bectoken.asm")).read()
+    bec_runtime = assemble(bec_src)
+    bn = len(bec_runtime)
+    bec_creation = (
+        assemble(
+            f"PUSH2 {bn}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\n"
+            f"PUSH2 {bn}\nPUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + bec_runtime.hex()
+    )
+    out.append(("bectoken", bec_creation, bec_runtime.hex(), 3, 120))
+    return out
+
+
+def _skew_demo():
+    """Skewed-fork workload straight through run_fused_mesh: all work
+    seeded on shards 0-1, steal must spread it across the mesh."""
+    import numpy as np
+
+    from mythril_tpu.disassembler.asm import assemble
+    from mythril_tpu.laser.tpu import megakernel
+    from mythril_tpu.laser.tpu import mesh as mesh_lib
+    from mythril_tpu.laser.tpu.batch import (
+        BatchConfig,
+        default_env,
+        empty_batch,
+        load_lane,
+        make_code_bank,
+    )
+
+    cfg = BatchConfig(lanes=64, stack_slots=16, memory_bytes=256,
+                      calldata_bytes=64, storage_slots=4, code_len=256)
+    cb = make_code_bank(
+        [assemble("here:\nJUMPDEST\nPUSH1 :here\nJUMP")], cfg.code_len
+    )
+    st = empty_batch(cfg)
+    # 16 spinning lanes, all inside the first two shard blocks (8/shard)
+    for lane in range(16):
+        st = load_lane(st, lane, calldata=b"", gas=10_000_000)
+    mesh = mesh_lib.make_mesh(N_DEVICES)
+    st = mesh_lib.shard_batch(st, mesh)
+    cb, env = mesh_lib.put_replicated((cb, default_env()), mesh)
+    out = megakernel.run_fused_mesh(
+        mesh, cb, env, st, max_rounds=4, steps_per_round=64
+    )
+    stats = megakernel.decode_mesh_info(out.info, N_DEVICES)
+    occ = list(stats.occupancy)
+    steps = int(np.asarray(out.st.steps).sum())
+    return {
+        "lanes": 16,
+        "seeded_shards": 2,
+        "rounds": stats.rounds,
+        "steal_events": stats.steal_events,
+        "steal_volume_lanes": stats.steal_lanes,
+        "frontier_occupancy": occ,
+        "occupancy_spread": max(occ) - min(occ),
+        "steps_retired": steps,
+        "steps_expected": 16 * stats.rounds * 64,
+    }
+
+
+def main():
+    import jax
+
+    result = {
+        "n_devices": N_DEVICES,
+        "rc": 0,
+        "ok": True,
+        "skipped": False,
+        "platform": jax.devices()[0].platform,
+        "contracts": {},
+    }
+    if len(jax.devices()) < N_DEVICES:
+        result.update(ok=False, skipped=True, rc=1)
+        _write(result)
+        return 1
+
+    _phase("skewed-fork steal demo (run_fused_mesh, 16 lanes on 2/8 shards)")
+    demo = _skew_demo()
+    result["skew_demo"] = demo
+    demo_ok = (
+        demo["steal_events"] >= 1
+        and demo["occupancy_spread"] <= 1
+        and demo["steps_retired"] == demo["steps_expected"]
+    )
+    _phase(f"  steal_events={demo['steal_events']} "
+           f"occ={demo['frontier_occupancy']} ok={demo_ok}")
+
+    equal_all = True
+    for name, creation, runtime, tx, budget in _contracts():
+        _phase(f"{name}: single-device fused (MYTHRIL_TPU_MESH=off)")
+        os.environ["MYTHRIL_TPU_MESH"] = "off"
+        issues_off, _ = _analyze(creation, runtime, name, tx, budget)
+        _phase(f"{name}: fused mesh (MYTHRIL_TPU_MESH=on)")
+        os.environ["MYTHRIL_TPU_MESH"] = "on"
+        issues_on, mesh_counters = _analyze(creation, runtime, name, tx, budget)
+        equal = issues_off == issues_on
+        equal_all = equal_all and equal
+        result["contracts"][name] = {
+            "issues_mesh_off": [list(i) for i in issues_off],
+            "issues_mesh_on": [list(i) for i in issues_on],
+            "issue_sets_equal": equal,
+            "mesh": mesh_counters,
+        }
+        _phase(f"  issues off={issues_off} on={issues_on} equal={equal}")
+
+    result["issue_sets_equal"] = equal_all
+    result["ok"] = bool(demo_ok and equal_all)
+    result["rc"] = 0 if result["ok"] else 1
+    _write(result)
+    _phase(f"done ok={result['ok']}")
+    return result["rc"]
+
+
+def _write(result):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "MULTICHIP_r06.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,12 +23,14 @@ def test_rebalance_preserves_lanes():
     cb, env, st = __graft_entry__._tiny_workload(lanes=16)
     # st is donated to sharded_round — snapshot before the call
     before = sorted(map(tuple, np.asarray(st.caller).tolist()))
-    out = mesh_lib.sharded_round(
+    out, occ = mesh_lib.sharded_round(
         cb, env, st, steps_per_round=4, do_rebalance=True, n_shards=8
     )
     # every original lane must still exist exactly once (permutation only)
     after = sorted(map(tuple, np.asarray(out.caller).tolist()))
     assert before == after
+    # the device-computed occupancy vector matches a host recount
+    assert np.asarray(occ).tolist() == mesh_lib.occupancy(out, 8).tolist()
 
 
 def test_rebalance_deals_running_lanes_evenly():
@@ -94,9 +96,15 @@ def test_sharded_round_completes_work():
     st = mesh_lib.shard_batch(st, mesh)
     cb = mesh_lib.put_replicated(cb, mesh)
     env = mesh_lib.put_replicated(env, mesh)
+    occ = None
     for _ in range(4):
-        st = mesh_lib.sharded_round(cb, env, st, steps_per_round=32, n_shards=8)
+        st, occ = mesh_lib.sharded_round(
+            cb, env, st, steps_per_round=32, n_shards=8
+        )
     status = np.asarray(st.status)
     alive = np.asarray(st.alive)
     assert not ((status == RUNNING) & alive).any()
     assert (status[alive] == STOPPED).all()
+    # quiescence is readable straight off the returned occupancy vector
+    assert int(np.asarray(occ).sum()) == 0
+    assert not mesh_lib.should_rebalance_occ(occ)

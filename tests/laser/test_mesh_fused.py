@@ -1,0 +1,368 @@
+"""Fused MESH megakernel tests (docs/MESH.md).
+
+Covers the three properties the fused mesh path must hold on the
+virtual 8-device CPU mesh:
+
+1. **equivalence** — run_fused_mesh produces the same machine states
+   (multiset over alive lanes; lane ORDER differs because compaction is
+   per-shard and stealing moves lanes) and the same coverage union as
+   the single-device run_fused on the same workload;
+2. **steal invariants** — the plan/apply pair preserves the multiset of
+   alive lanes, never splits a lane across shards, lands on a fair deal,
+   and respects receiver free-lane capacity;
+3. **policy** — backend._mesh_tier / planned_mesh_factor pick the right
+   tier for each MYTHRIL_TPU_MESH x platform combination.
+
+Every device test in this file shares one BatchConfig and
+steps_per_round=64: both are static compile keys, so sharing them keeps
+the file at a handful of XLA compiles instead of one per test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.laser.tpu import backend, megakernel
+from mythril_tpu.laser.tpu import mesh as mesh_lib
+from mythril_tpu.laser.tpu.batch import (
+    RUNNING,
+    STOPPED,
+    BatchConfig,
+    default_env,
+    empty_batch,
+    load_lane,
+    make_code_bank,
+)
+
+N_SHARDS = 8
+CFG = BatchConfig(lanes=16, stack_slots=16, memory_bytes=256,
+                  calldata_bytes=64, storage_slots=4, code_len=256)
+
+# calldata-driven countdown: lane i spins calldataload(0) iterations, so
+# different lanes drain at different rounds and shard occupancy skews as
+# the short lanes finish — exactly the shape that fires the steal path
+COUNTDOWN_SRC = """
+    PUSH1 0x00
+    CALLDATALOAD
+loop:
+    JUMPDEST
+    DUP1
+    ISZERO
+    PUSH2 :done
+    JUMPI
+    PUSH1 0x01
+    SWAP1
+    SUB
+    PUSH2 :loop
+    JUMP
+done:
+    JUMPDEST
+    STOP
+"""
+
+LOOP_SRC = "here:\nJUMPDEST\nPUSH1 :here\nJUMP"
+
+
+def _countdown_workload(lanes=16):
+    cb = make_code_bank([assemble(COUNTDOWN_SRC)], CFG.code_len)
+    st = empty_batch(CFG)
+    for lane in range(lanes):
+        st = load_lane(
+            st, lane,
+            calldata=(lane * 7 + 1).to_bytes(32, "big"),
+            gas=10_000_000,
+        )
+    return cb, default_env(), st
+
+
+def _alive_multiset(st):
+    """Multiset of per-lane machine-state tuples over the alive lanes.
+
+    Lane position is NOT part of the tuple: per-shard compaction and
+    stealing permute lanes, and the bridge resolves identity through
+    the seed_id/job_id planes, never through raw positions."""
+    alive = np.asarray(st.alive)
+    cols = [np.asarray(getattr(st, f))[alive]
+            for f in ("status", "pc", "steps", "gas_left", "code_id")]
+    return sorted(zip(*(c.tolist() for c in cols)))
+
+
+def _coverage_union(st, pruned_visited):
+    """bool[n_codes, W] union of alive-lane coverage + pruned coverage."""
+    alive = np.asarray(st.alive)
+    visited = np.asarray(st.visited)
+    code_id = np.asarray(st.code_id)
+    out = np.asarray(pruned_visited).copy()
+    for lane in np.nonzero(alive)[0]:
+        out[code_id[lane]] |= visited[lane]
+    return out
+
+
+# -- 1. fused mesh vs single-device fused equivalence ------------------
+
+
+def test_fused_mesh_matches_single_device_fused():
+    mesh = mesh_lib.make_mesh(N_SHARDS)
+
+    cb, env, st = _countdown_workload()
+    single = megakernel.run_fused(
+        cb, env, st, max_rounds=20, steps_per_round=64
+    )
+    s_stats = megakernel.decode_info(single.info)
+
+    cb2, env2, st2 = _countdown_workload()
+    st2 = mesh_lib.shard_batch(st2, mesh)
+    cb2, env2 = mesh_lib.put_replicated((cb2, env2), mesh)
+    meshed = megakernel.run_fused_mesh(
+        mesh, cb2, env2, st2, max_rounds=20, steps_per_round=64
+    )
+    m_stats = megakernel.decode_mesh_info(meshed.info, N_SHARDS)
+
+    # stepping is lane-local and lockstep on both paths, so the scalar
+    # accounting must agree exactly
+    assert m_stats.rounds == s_stats.rounds
+    assert m_stats.n_alive == s_stats.n_alive == 16
+    assert m_stats.n_running == s_stats.n_running == 0
+    assert m_stats.pruned_lanes == s_stats.pruned_lanes == 0
+    assert sum(m_stats.occupancy) == 0
+
+    # same machine states, as a multiset (lane order legitimately
+    # differs: per-shard compaction + steal moves)
+    assert _alive_multiset(meshed.st) == _alive_multiset(single.st)
+    assert np.asarray(meshed.st.status)[np.asarray(meshed.st.alive)].tolist() \
+        == [STOPPED] * 16
+
+    # same coverage union (steal carries the visited plane with the lane)
+    assert np.array_equal(
+        _coverage_union(meshed.st, meshed.pruned_visited),
+        _coverage_union(single.st, single.pruned_visited),
+    )
+
+
+def test_fused_mesh_with_stats_hist_matches_single_device():
+    mesh = mesh_lib.make_mesh(N_SHARDS)
+    cb, env, st = _countdown_workload()
+    single = megakernel.run_fused(
+        cb, env, st, max_rounds=20, steps_per_round=64, with_stats=True
+    )
+    cb2, env2, st2 = _countdown_workload()
+    st2 = mesh_lib.shard_batch(st2, mesh)
+    cb2, env2 = mesh_lib.put_replicated((cb2, env2), mesh)
+    meshed = megakernel.run_fused_mesh(
+        mesh, cb2, env2, st2, max_rounds=20, steps_per_round=64,
+        with_stats=True,
+    )
+    h_single = np.asarray(single.hist)
+    h_mesh = np.asarray(meshed.hist)
+    # psum-folded per-shard histograms == the global one, bin for bin
+    assert h_mesh.shape == (256,)
+    assert np.array_equal(h_mesh, h_single)
+    assert int(h_mesh.sum()) == int(
+        np.asarray(single.st.steps).sum()
+    )
+
+
+def test_run_fused_mesh_rejects_indivisible_lanes():
+    mesh = mesh_lib.make_mesh(N_SHARDS)
+    cfg = CFG._replace(lanes=12)
+    cb = make_code_bank([assemble(COUNTDOWN_SRC)], cfg.code_len)
+    st = empty_batch(cfg)
+    with pytest.raises(ValueError, match="not divisible"):
+        megakernel.run_fused_mesh(
+            mesh, cb, default_env(), st, max_rounds=1, steps_per_round=64
+        )
+
+
+# -- 2. steal plan/apply invariants ------------------------------------
+
+
+def _steal_once(st):
+    """One-shot jitted shard_map around the plan/apply pair, returning
+    (st', moved, occ_before) — the same sequence the fused loop body
+    runs between rounds, minus the stepping."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = mesh_lib.make_mesh(N_SHARDS)
+
+    def body(s):
+        plan = mesh_lib.steal_plan(s, N_SHARDS)
+        s2 = jax.lax.cond(
+            plan.moved > 0,
+            lambda x: mesh_lib.steal_apply(x, plan, N_SHARDS),
+            lambda x: x,
+            s,
+        )
+        return s2, plan.moved, plan.occ
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("paths"),),
+        out_specs=(P("paths"), P(), P()),
+        check_rep=False,
+    )
+    return jax.jit(fn)(mesh_lib.shard_batch(st, mesh))
+
+
+def _tagged_batch(running_lanes, halted_lanes=()):
+    """Batch whose per-lane planes carry distinct tags, with the alive
+    lanes forming a dense prefix inside each shard block (the invariant
+    compact_impl guarantees before every steal)."""
+    st = empty_batch(CFG)
+    L = CFG.lanes
+    alive = np.zeros(L, bool)
+    status = np.full(L, STOPPED, np.int32)
+    for lane in running_lanes:
+        alive[lane] = True
+        status[lane] = RUNNING
+    for lane in halted_lanes:
+        alive[lane] = True
+        status[lane] = STOPPED
+    pc = 100 + np.arange(L, dtype=np.int32)
+    steps = 1000 + np.arange(L, dtype=np.int32)
+    gas = 5000 + np.arange(L, dtype=np.int64)
+    stack = np.asarray(st.stack).copy()
+    stack[:, 0] = np.arange(L)
+    visited = np.zeros(np.asarray(st.visited).shape, bool)
+    for lane in range(L):
+        visited[lane, lane % visited.shape[1]] = True
+    return st._replace(
+        alive=jnp.asarray(alive),
+        status=jnp.asarray(status),
+        pc=jnp.asarray(pc),
+        steps=jnp.asarray(steps.astype(np.asarray(st.steps).dtype)),
+        gas_left=jnp.asarray(gas.astype(np.asarray(st.gas_left).dtype)),
+        stack=jnp.asarray(stack),
+        visited=jnp.asarray(visited),
+    )
+
+
+def _lane_tuples(st):
+    """(pc, steps, gas, stack-tag, visited-row) per alive lane: if a
+    steal ever split a lane's planes across shards, the tag fields of
+    some tuple would disagree with each other."""
+    alive = np.asarray(st.alive)
+    pc = np.asarray(st.pc)
+    steps = np.asarray(st.steps)
+    gas = np.asarray(st.gas_left)
+    stack = np.asarray(st.stack)
+    visited = np.asarray(st.visited)
+    out = []
+    for lane in np.nonzero(alive)[0]:
+        out.append((
+            int(pc[lane]), int(steps[lane]), int(gas[lane]),
+            int(stack[lane, 0]),
+            tuple(np.nonzero(visited[lane])[0].tolist()),
+        ))
+    return sorted(out)
+
+
+def test_steal_rebalances_skew_and_never_splits_a_lane():
+    # all 4 running lanes on shards 0-1 (per-shard dense prefixes)
+    st = _tagged_batch(running_lanes=[0, 1, 2, 3])
+    before = _lane_tuples(st)
+    out, moved, occ = _steal_once(st)
+    assert np.asarray(occ).tolist() == [2, 2, 0, 0, 0, 0, 0, 0]
+    assert int(moved) == 2
+    after_occ = mesh_lib.occupancy(out, N_SHARDS)
+    assert after_occ.tolist() == [1, 1, 1, 1, 0, 0, 0, 0]
+    # multiset of lanes preserved, every lane's planes still coherent
+    after = _lane_tuples(out)
+    assert after == before
+    for pc, steps, gas, tag, vis in after:
+        # all tag fields must name the SAME original lane
+        assert pc - 100 == steps - 1000 == gas - 5000 == tag
+        assert vis == (tag % np.asarray(st.visited).shape[1],)
+
+
+def test_steal_respects_receiver_capacity():
+    # shard 2 is full of halted-but-alive lanes: it has a deficit by
+    # occupancy but zero free lanes, so the plan must route around it
+    st = _tagged_batch(running_lanes=[0, 1, 2, 3], halted_lanes=[4, 5])
+    before = _lane_tuples(st)
+    out, moved, occ = _steal_once(st)
+    assert np.asarray(occ).tolist() == [2, 2, 0, 0, 0, 0, 0, 0]
+    # fair-share targets give shards 2 and 3 one lane each, but shard 2
+    # cannot absorb: only one lane moves (to shard 3)
+    assert int(moved) == 1
+    after_occ = mesh_lib.occupancy(out, N_SHARDS)
+    assert after_occ.tolist() == [1, 2, 0, 1, 0, 0, 0, 0]
+    assert _lane_tuples(out) == before
+
+
+def test_steal_noop_when_balanced_or_empty():
+    # balanced: one running lane per shard -> moved == 0, batch unchanged
+    st = _tagged_batch(running_lanes=[0, 2, 4, 6, 8, 10, 12, 14])
+    before = _lane_tuples(st)
+    out, moved, occ = _steal_once(st)
+    assert int(moved) == 0
+    assert np.asarray(occ).tolist() == [1] * 8
+    assert _lane_tuples(out) == before
+    # empty frontier -> nothing to plan
+    out, moved, occ = _steal_once(empty_batch(CFG))
+    assert int(moved) == 0
+    assert int(np.asarray(occ).sum()) == 0
+
+
+def test_fused_mesh_steal_fires_under_skewed_forks():
+    # 4 infinite-loop lanes concentrated on shards 0-1: the fused loop
+    # must fire >= 1 in-loop steal and end with spread <= 1, and the
+    # steal must not cost any lane a step (total == lanes*rounds*steps)
+    mesh = mesh_lib.make_mesh(N_SHARDS)
+    cb = make_code_bank([assemble(LOOP_SRC)], CFG.code_len)
+    st = empty_batch(CFG)
+    for lane in range(4):
+        st = load_lane(st, lane, calldata=b"", gas=10_000_000)
+    st = mesh_lib.shard_batch(st, mesh)
+    cb, env = mesh_lib.put_replicated((cb, default_env()), mesh)
+    out = megakernel.run_fused_mesh(
+        mesh, cb, env, st, max_rounds=3, steps_per_round=64
+    )
+    stats = megakernel.decode_mesh_info(out.info, N_SHARDS)
+    assert stats.rounds == 3
+    assert stats.n_running == 4
+    assert stats.steal_events >= 1
+    assert stats.steal_lanes >= 2
+    occ = stats.occupancy
+    assert sum(occ) == 4
+    assert max(occ) - min(occ) <= 1, f"steal left skew: {occ}"
+    assert int(np.asarray(out.st.steps).sum()) == 4 * 3 * 64
+
+
+# -- 3. tier policy ----------------------------------------------------
+
+
+def test_mesh_tier_policy(monkeypatch):
+    monkeypatch.delenv("MYTHRIL_TPU_MESH", raising=False)
+    monkeypatch.delenv("MYTHRIL_TPU_FUSED", raising=False)
+    # auto: multi-device accelerators shard, the CPU test mesh does not
+    assert backend._mesh_tier(8, "cpu") == "off"
+    assert backend._mesh_tier(8, "tpu") == "fused"
+    # a single device can never mesh
+    assert backend._mesh_tier(1, "tpu") == "off"
+    # explicit overrides
+    monkeypatch.setenv("MYTHRIL_TPU_MESH", "on")
+    assert backend._mesh_tier(8, "cpu") == "fused"
+    monkeypatch.setenv("MYTHRIL_TPU_MESH", "sync")
+    assert backend._mesh_tier(8, "cpu") == "sync"
+    monkeypatch.setenv("MYTHRIL_TPU_MESH", "off")
+    assert backend._mesh_tier(8, "tpu") == "off"
+    # fused disabled -> mesh degrades to the sync tier, not to off
+    monkeypatch.setenv("MYTHRIL_TPU_MESH", "on")
+    monkeypatch.setenv("MYTHRIL_TPU_FUSED", "off")
+    assert backend._mesh_tier(8, "cpu") == "sync"
+    # garbage mode falls back to MESH_MODE ("auto")
+    monkeypatch.delenv("MYTHRIL_TPU_FUSED", raising=False)
+    monkeypatch.setenv("MYTHRIL_TPU_MESH", "bogus")
+    assert backend._mesh_tier(8, "cpu") == "off"
+
+
+def test_planned_mesh_factor(monkeypatch):
+    # the 8 virtual CPU devices mesh when forced on -> watchdog headroom
+    monkeypatch.setenv("MYTHRIL_TPU_MESH", "on")
+    assert backend.planned_mesh_factor() == backend.MESH_WATCHDOG_FACTOR
+    monkeypatch.setenv("MYTHRIL_TPU_MESH", "off")
+    assert backend.planned_mesh_factor() == 1.0

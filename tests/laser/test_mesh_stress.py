@@ -90,10 +90,12 @@ def test_forking_imbalance_is_rebalanced(mesh):
     # a few lockstep steps WITHOUT rebalancing: shard 0's lanes fork into
     # the lowest-index free lanes (its own block first) while the other
     # shards' seed lanes halt -> measured occupancy must be skewed
-    st = mesh_lib.sharded_round(
+    st, occ_dev = mesh_lib.sharded_round(
         cb, env, st, steps_per_round=8, do_rebalance=False, n_shards=N_SHARDS
     )
     occ_before = mesh_lib.occupancy(st, N_SHARDS)
+    # the device-side occupancy fold matches the host recount
+    assert np.asarray(occ_dev).tolist() == occ_before.tolist()
     assert occ_before.sum() >= 4, f"forks did not materialize: {occ_before}"
     assert occ_before.max() - occ_before.min() > 1, (
         f"workload failed to skew: {occ_before}"
@@ -103,10 +105,11 @@ def test_forking_imbalance_is_rebalanced(mesh):
     # one rebalancing round: the all-to-all must deal the running lanes
     # evenly (spread <= 1) while preserving every lane exactly once
     before_ids = sorted(np.asarray(st.seed_id).tolist())
-    st = mesh_lib.sharded_round(
+    st, occ_dev = mesh_lib.sharded_round(
         cb, env, st, steps_per_round=0, do_rebalance=True, n_shards=N_SHARDS
     )
     occ_after = mesh_lib.occupancy(st, N_SHARDS)
+    assert np.asarray(occ_dev).tolist() == occ_after.tolist()
     assert occ_after.sum() == occ_before.sum()
     assert occ_after.max() - occ_after.min() <= 1, f"still skewed: {occ_after}"
     assert sorted(np.asarray(st.seed_id).tolist()) == before_ids
@@ -120,9 +123,12 @@ def test_checkpoint_restore_mid_run_matches_uninterrupted(mesh):
     cb_r, env = mesh_lib.put_replicated((cb, default_env()), mesh)
 
     def rounds(st, n):
+        # stateless gating on purpose: the resumed half must make the
+        # same rebalance decisions as the uninterrupted run without
+        # carrying the previous dispatch's occupancy across the restore
         for _ in range(n):
             do_reb = mesh_lib.should_rebalance(st, N_SHARDS)
-            st = mesh_lib.sharded_round(
+            st, _occ = mesh_lib.sharded_round(
                 cb_r, env, st,
                 steps_per_round=4, do_rebalance=do_reb, n_shards=N_SHARDS,
             )

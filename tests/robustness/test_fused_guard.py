@@ -52,7 +52,7 @@ def capture_deadline(monkeypatch):
     monkeypatch.setattr(backend, "_run_device", _run_device)
     from mythril_tpu.laser.tpu import transfer
 
-    monkeypatch.setattr(transfer, "batch_to_host", lambda out: out)
+    monkeypatch.setattr(transfer, "batch_to_host", lambda out, n_shards=1: out)
     return seen
 
 

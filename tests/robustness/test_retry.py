@@ -32,7 +32,9 @@ def stub_round(monkeypatch):
         return outcome, ["hist"]
 
     monkeypatch.setattr(backend, "_run_device", _run_device)
-    monkeypatch.setattr(transfer, "batch_to_host", lambda out: ("host", out))
+    monkeypatch.setattr(
+        transfer, "batch_to_host", lambda out, n_shards=1: ("host", out)
+    )
     return script
 
 
@@ -113,7 +115,7 @@ def test_transfer_down_fault_is_absorbed_by_one_retry(stub_round):
 
     calls = []
 
-    def flaky(out):
+    def flaky(out, n_shards=1):
         calls.append(out)
         faults.fire(faults.TRANSFER_DOWN, context="batch_to_host")
         return ("host", out)
