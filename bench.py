@@ -473,11 +473,11 @@ def _watchdog_main() -> int:
             except OSError:
                 pass
     progress["partial"] = True
-    # service runs carry their own metric shape; emit the checkpointed
-    # dict as-is instead of forcing it through the states/s formatter
+    # service/fleet runs carry their own metric shape; emit the
+    # checkpointed dict as-is instead of the states/s formatter
     emit = (
         (lambda p: print(json.dumps(p)))
-        if "--service" in sys.argv[1:]
+        if ("--service" in sys.argv[1:] or "--fleet" in sys.argv[1:])
         else _emit
     )
     if child_rc is not None and child_rc != 0:
@@ -619,6 +619,285 @@ def _service_bench() -> int:
     return 0
 
 
+def _fleet_bench() -> int:
+    """``bench.py --fleet``: the fleet-tier acceptance run. A gateway
+    over TWO worker subprocesses sharing one durable store, measured
+    against a single-process reference on the same two contracts:
+
+      * SWC issue sets through the fleet == single-process sets;
+      * a ``watch`` stream delivers an issue event to the client BEFORE
+        the blocking ``result`` call returns (latency-to-first-issue);
+      * kill -9 of the worker that analyzed a contract, then a
+        duplicate submission: the gateway re-routes and the survivor
+        answers from the SHARED store (cross-process warm hit);
+      * the killed worker restarts on the same store and still knows
+        the contract's solver memos and an operator quarantine;
+      * a short chain scan records contracts/hour, p50/p95, warm-hit
+        rate, and p50 latency-to-first-issue.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from mythril_tpu.fleet import transport
+    from mythril_tpu.fleet.gateway import Gateway, GatewayServer
+    from mythril_tpu.fleet.ingest import ChainScan, load_corpus
+    from mythril_tpu.fleet.qos import AdmissionController
+    from mythril_tpu.fleet.worker import (
+        SocketWorker,
+        spawn_worker,
+        wait_for_socket,
+    )
+    from mythril_tpu.service import AnalysisService
+
+    workload = [("Token", "token.asm", 2), ("MultiOwner", "multiowner.asm", 2)]
+    progress = {"metric": "fleet_bench"}
+
+    # --- single-process reference: the SWC truth for both contracts ---
+    _phase("fleet: single-process reference run")
+    reference = AnalysisService(workers=2, gather_window_s=0.5)
+    ref_swcs = {}
+    contracts = {}
+    for name, asm, tx in workload:
+        runtime_hex, creation_hex = _load_bench_contract(asm)
+        contracts[name] = (runtime_hex, creation_hex, tx)
+        job_id = reference.submit(
+            runtime_hex, creation_hex, tx_count=tx, timeout=120, name=name
+        )
+        assert reference.wait(job_id, timeout=900), "reference %s hung" % name
+        status = reference.status(job_id)
+        assert status["state"] == "done", "reference %s: %r" % (name, status)
+        ref_swcs[name] = sorted(reference.result(job_id)["swc_ids"])
+        _phase("fleet: reference %s -> %r" % (name, ref_swcs[name]))
+    reference.shutdown(wait=False)
+    progress["reference_swcs"] = ref_swcs
+    _checkpoint(progress)
+
+    # --- the fleet: 2 workers, one shared durable store, one gateway ---
+    run_dir = tempfile.mkdtemp(prefix="mythril-fleet-bench.")
+    store_dir = os.path.join(run_dir, "store")
+    procs, logs = {}, {}
+    gw = server = None
+
+    def _spawn(name):
+        sock = os.path.join(run_dir, name + ".sock")
+        logs[name] = open(os.path.join(run_dir, name + ".log"), "ab")
+        procs[name] = spawn_worker(
+            sock, store_dir=store_dir, workers=2, stderr=logs[name]
+        )
+        return SocketWorker(name, sock)
+
+    try:
+        _phase("fleet: spawning 2 workers on shared store")
+        workers = [_spawn("w0"), _spawn("w1")]
+        for worker in workers:
+            wait_for_socket(
+                worker.address, timeout_s=300, process=procs[worker.name]
+            )
+        gw = Gateway(
+            workers,
+            admission=AdmissionController(base_rate_per_s=50.0, burst=100.0),
+        )
+        gw.start()
+        server = GatewayServer(gw)
+        server.start()
+        addr = server.address
+        _phase("fleet: gateway serving on %s" % addr)
+
+        # --- contract A through the gateway, with a live watch ---
+        name_a, (runtime_a, creation_a, tx_a) = "Token", contracts["Token"]
+        t_submit = time.time()
+        sub_a = transport.request(addr, {
+            "op": "submit", "code": runtime_a, "creation_code": creation_a,
+            "tx_count": tx_a, "timeout": 600, "name": name_a,
+        }, timeout=15)
+        assert sub_a["ok"], sub_a
+        gid_a, owner = sub_a["job_id"], sub_a["worker"]
+        watch = {"first_issue_t": None, "result_pending": None, "events": []}
+
+        def _watcher():
+            try:
+                for event in transport.stream(
+                    addr, {"op": "watch", "job_id": gid_a}, timeout=900
+                ):
+                    watch["events"].append(event)
+                    if (event.get("event") == "issue"
+                            and watch["first_issue_t"] is None):
+                        watch["first_issue_t"] = time.time()
+                        watch["result_pending"] = not watch.get("done")
+            except (OSError, ValueError):
+                pass
+
+        watcher = threading.Thread(target=_watcher, daemon=True)
+        watcher.start()
+        res_a = transport.request(
+            addr, {"op": "result", "job_id": gid_a, "timeout": 600},
+            timeout=900,
+        )
+        watch["done"] = True
+        t_done = time.time()
+        watcher.join(timeout=30)
+        assert res_a["ok"] and res_a["state"] == "done", res_a
+        assert not res_a["cache_hit"], "cold run must not warm-hit"
+        swcs_a = sorted(res_a["result"]["swc_ids"])
+        assert watch["first_issue_t"] is not None, (
+            "no issue event streamed: %r" % watch["events"][-3:]
+        )
+        # the stream beat the blocking result call: partial results are real
+        assert watch["result_pending"], "issue event arrived after completion"
+        progress.update(
+            fleet_first_issue_s=round(watch["first_issue_t"] - t_submit, 2),
+            fleet_stream_lead_s=round(t_done - watch["first_issue_t"], 2),
+            fleet_cold_wall_s=round(t_done - t_submit, 2),
+        )
+        _checkpoint(progress)
+        _phase(
+            "fleet: %s done on %s, first issue streamed %.1fs before result"
+            % (name_a, owner, t_done - watch["first_issue_t"])
+        )
+
+        # --- contract B, plain request/response ---
+        name_b, (runtime_b, creation_b, tx_b) = (
+            "MultiOwner", contracts["MultiOwner"],
+        )
+        sub_b = transport.request(addr, {
+            "op": "submit", "code": runtime_b, "creation_code": creation_b,
+            "tx_count": tx_b, "timeout": 600, "name": name_b,
+        }, timeout=15)
+        assert sub_b["ok"], sub_b
+        res_b = transport.request(
+            addr, {"op": "result", "job_id": sub_b["job_id"], "timeout": 600},
+            timeout=900,
+        )
+        assert res_b["ok"] and res_b["state"] == "done", res_b
+        swcs_b = sorted(res_b["result"]["swc_ids"])
+
+        # acceptance: identical SWC sets vs the single-process reference
+        assert swcs_a == ref_swcs[name_a], (swcs_a, ref_swcs[name_a])
+        assert swcs_b == ref_swcs[name_b], (swcs_b, ref_swcs[name_b])
+        progress["fleet_swcs"] = {name_a: swcs_a, name_b: swcs_b}
+        _checkpoint(progress)
+
+        # --- durable state before the kill: memos + operator quarantine ---
+        probe_pre = transport.request(addr, {
+            "op": "probe", "code": runtime_a, "creation_code": creation_a,
+            "worker": owner,
+        }, timeout=15)
+        assert probe_pre["ok"] and probe_pre["memo_verdicts"] > 0, probe_pre
+        poison = "deadbeef60016001"
+        assert transport.request(addr, {
+            "op": "quarantine", "code": poison, "worker": owner,
+            "reason": "fleet bench operator",
+        }, timeout=15)["ok"]
+
+        # --- kill -9 the owner; duplicate must warm-hit the survivor ---
+        _phase("fleet: kill -9 %s, resubmitting duplicate of %s"
+               % (owner, name_a))
+        procs[owner].kill()
+        procs[owner].wait()
+        dup = transport.request(addr, {
+            "op": "submit", "code": runtime_a, "creation_code": creation_a,
+            "tx_count": tx_a, "timeout": 600, "name": name_a,
+        }, timeout=30)
+        assert dup["ok"], dup
+        survivor = dup["worker"]
+        assert survivor != owner, "duplicate landed on the dead worker"
+        warm = transport.request(
+            addr, {"op": "result", "job_id": dup["job_id"], "timeout": 120},
+            timeout=200,
+        )
+        assert warm["ok"] and warm["cache_hit"], (
+            "no cross-process warm hit: %r" % warm
+        )
+        assert sorted(warm["result"]["swc_ids"]) == swcs_a
+        fleet_stats = transport.request(
+            addr, {"op": "fleet_stats"}, timeout=15
+        )
+        survivor_cache = fleet_stats["workers"][survivor]["cache"]
+        assert survivor_cache["cross_process_hits"] >= 1, survivor_cache
+        # the warm job replays the full issue stream, source-tagged
+        replayed = list(transport.stream(
+            addr, {"op": "watch", "job_id": dup["job_id"]}, timeout=60
+        ))
+        assert replayed[0].get("event") == "issue", replayed[:2]
+        assert replayed[0].get("source") == "cache", replayed[0]
+        progress.update(
+            warm_wall_s=round(float(warm["wall_s"] or 0.0), 4),
+            cross_process_hits=survivor_cache["cross_process_hits"],
+            gateway_reroutes=fleet_stats["gateway"]["reroutes"],
+            worker_deaths=fleet_stats["gateway"]["worker_deaths"],
+        )
+        _checkpoint(progress)
+
+        # --- restart the dead worker on the SAME store: durability ---
+        _phase("fleet: restarting %s on the shared store" % owner)
+        sock = os.path.join(run_dir, owner + ".sock")
+        try:
+            os.remove(sock)
+        except OSError:
+            pass
+        procs[owner] = spawn_worker(
+            sock, store_dir=store_dir, workers=2, stderr=logs[owner]
+        )
+        wait_for_socket(sock, timeout_s=300, process=procs[owner])
+        gw.health_tick()  # revive-on-ping
+        probe_post = transport.request(addr, {
+            "op": "probe", "code": runtime_a, "creation_code": creation_a,
+            "worker": owner,
+        }, timeout=15)
+        assert probe_post["ok"] and probe_post["memo_verdicts"] > 0, (
+            "solver memos lost across restart: %r" % probe_post
+        )
+        poison_probe = transport.request(addr, {
+            "op": "probe", "code": poison, "worker": owner,
+        }, timeout=15)
+        assert poison_probe["quarantined"], poison_probe
+        assert poison_probe["quarantine_reason"] == "fleet bench operator"
+        progress.update(
+            restart_memo_verdicts=probe_post["memo_verdicts"],
+            restart_quarantine_intact=True,
+        )
+        _checkpoint(progress)
+
+        # --- chain scan: throughput + warm-hit-rate + stream latency ---
+        _phase("fleet: chain scan (6 deployments, dup_rate=0.5)")
+        scan = ChainScan(
+            SocketWorker("gateway", addr),
+            corpus=load_corpus(["token", "multiowner"]),
+            seed=20260808,
+            dup_rate=0.5,
+            watch_fraction=0.5,
+            tx_count=2,
+            timeout=300,
+            result_timeout_s=900.0,
+        )
+        t_scan = time.time()
+        scan_summary = scan.run(6)
+        scan_summary["elapsed_s"] = round(time.time() - t_scan, 2)
+        assert scan_summary["failures"] == 0, scan_summary
+        assert scan_summary["completed"] == 6, scan_summary
+        progress["scan"] = scan_summary
+        _checkpoint(progress)
+        _phase("fleet: done")
+        print(json.dumps(progress))
+        return 0
+    finally:
+        if server is not None:
+            server.stop()
+        if gw is not None:
+            gw.stop()
+        for proc in procs.values():
+            proc.kill()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        for log in logs.values():
+            log.close()
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def _rewrite_ab_bench() -> int:
     """``bench.py --rewrite-ab``: the stage-3 rewrite pass's acceptance
     run (docs/REWRITE_PASS.md). The becstress steady-state protocol
@@ -738,6 +1017,8 @@ def main() -> int:
 
     if "--service" in sys.argv[1:]:
         return _service_bench()
+    if "--fleet" in sys.argv[1:]:
+        return _fleet_bench()
     if "--rewrite-ab" in sys.argv[1:]:
         return _rewrite_ab_bench()
 

@@ -8,10 +8,11 @@ ProbeModule base most built-ins use lives in probe.py."""
 import logging
 from abc import ABC, abstractmethod
 from enum import Enum
-from typing import FrozenSet, List, Optional, Set
+from typing import FrozenSet, Iterable, List, Optional, Set
 
 from mythril_tpu.analysis.report import Issue
 from mythril_tpu.laser.evm.state.global_state import GlobalState
+from mythril_tpu.support.events import ISSUE_BUS
 
 log = logging.getLogger(__name__)
 
@@ -19,6 +20,27 @@ log = logging.getLogger(__name__)
 class EntryPoint(Enum):
     POST = 1
     CALLBACK = 2
+
+
+class IssueList(List[Issue]):
+    """A module's ``issues`` list that publishes every NEW finding to
+    the issue event bus (support/events.py) the moment a hook appends
+    it — the seam streaming partial results hangs off. Only append
+    paths publish: wrapping an existing list (reset, the service's
+    name-filtered harvest reassigning the kept remainder) republishes
+    nothing, so an issue is announced exactly once."""
+
+    def append(self, issue: Issue) -> None:
+        super().append(issue)
+        ISSUE_BUS.publish(getattr(issue, "contract", ""), issue)
+
+    def extend(self, issues: Iterable[Issue]) -> None:
+        for issue in issues:
+            self.append(issue)
+
+    def __iadd__(self, issues: Iterable[Issue]) -> "IssueList":
+        self.extend(issues)
+        return self
 
 
 class DetectionModule(ABC):
@@ -41,13 +63,24 @@ class DetectionModule(ABC):
     tape_replay_hooks: FrozenSet[str] = frozenset()
 
     def __init__(self) -> None:
-        self.issues: List[Issue] = []
+        self._issues: IssueList = IssueList()
         # reported-site dedup keys: (contract name, byte address). The
         # contract component is load-bearing for the multi-tenant
         # analysis service: modules are process singletons, and a bare
         # address would collide across concurrently running jobs (each
         # job analyzes under a unique contract name)
         self.cache: Set[tuple] = set()
+
+    @property
+    def issues(self) -> IssueList:
+        return self._issues
+
+    @issues.setter
+    def issues(self, value: Iterable[Issue]) -> None:
+        # every reassignment (reset_module, the service harvest's
+        # ``module.issues = keep``) stays a publishing IssueList; the
+        # wrap itself publishes nothing (see IssueList)
+        self._issues = IssueList(value)
 
     def reset_module(self):
         self.issues = []

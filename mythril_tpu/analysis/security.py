@@ -14,6 +14,7 @@ from mythril_tpu.analysis.module.base import EntryPoint
 from mythril_tpu.analysis.module.loader import ModuleLoader
 from mythril_tpu.analysis.module.util import reset_callback_modules
 from mythril_tpu.analysis.report import Issue
+from mythril_tpu.support.events import ISSUE_BUS
 
 log = logging.getLogger(__name__)
 
@@ -88,7 +89,14 @@ def fire_lasers_for_job(
     ):
         t0 = time.perf_counter()
         with obs.TRACER.span("module", tid="module", module=module.name):
-            collected.extend(module.execute(statespace) or [])
+            found = module.execute(statespace) or []
         _cat.MODULE_EXEC_S.inc(time.perf_counter() - t0, module.name)
+        # POST modules RETURN findings instead of appending to their
+        # issues list, so the streaming seam (module/base.IssueList)
+        # never sees them — publish here, per module, so a `watch`
+        # stream gets them as each scan finishes rather than at job end
+        for issue in found:
+            ISSUE_BUS.publish(getattr(issue, "contract", ""), issue)
+        collected.extend(found)
     collected.extend(harvest_callback_issues(contract_names, white_list))
     return collected

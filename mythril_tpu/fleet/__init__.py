@@ -1,0 +1,36 @@
+"""Fleet tier: the distributed layer above the in-process service.
+
+ROADMAP item 3: the `mythril_tpu/service/` scheduler is one Python
+process, one GIL, one device. This package is the production shape on
+top of it (docs/FLEET.md):
+
+  gateway.py   front gateway — TCP + minimal HTTP/JSON speaking the
+               same line-delimited op protocol as service/api.py,
+               consistent-hash routing on keccak(code) to N workers,
+               worker-death detection + job re-route, streaming
+               `watch` forwarding, per-tenant QoS admission
+  hashring.py  the consistent hash ring (virtual nodes, keccak-based)
+  store.py     DurableStore + DurableResultCache — LevelDB-style
+               append-log + index segments on disk behind the
+               ResultCache interface, so issue reports, solver memos
+               and quarantine strikes survive restarts and are shared
+               across worker processes
+  transport.py address parsing + bounded line-JSON client plumbing
+               shared by the gateway, the CLI and the ingest driver
+  qos.py       per-tenant token buckets with admission thresholds
+               auto-tuned from live worker metrics (queue depth,
+               warm-hit rate, breaker state)
+  worker.py    worker handles (socket-backed subprocess workers and
+               in-process stubs for tests) + the spawn helper
+  ingest.py    `myth scan` — the chain-scan traffic generator that
+               replays a fixture corpus of "newly deployed" contracts
+               through the fleet
+
+The gateway and store are deliberately DEVICE-FREE: they must start
+without jax or a TPU attached (enforced by the `fleet_boundary` lint
+rule). Only worker processes own devices.
+"""
+
+from mythril_tpu.fleet.hashring import HashRing, code_key
+
+__all__ = ["HashRing", "code_key"]

@@ -1,0 +1,725 @@
+"""The fleet front gateway: TCP + HTTP/JSON over the service protocol.
+
+One process, no device (fleet_boundary lint rule — it must start on a
+machine with no TPU and no jax import): the gateway owns the public
+face of the fleet and routes every operation to the worker that should
+serve it.
+
+Routing. Submissions route on ``keccak(creation ‖ runtime)`` — the
+SAME key the result cache uses — over a consistent-hash ring
+(hashring.py), so a duplicate deployment lands on the worker already
+holding the warm entry. Job-scoped ops route on the gateway job id
+``"<worker>:<worker job id>"`` minted at submission.
+
+Robustness. A connection failure to a worker marks it dead: it leaves
+the ring (submissions fail over to the next node, which warm-hits the
+durable store for anything the dead worker had finished) and the
+health loop keeps pinging it for revival. Job-scoped ops on a dead
+worker RE-ROUTE: the gateway kept the original submit request, resubmits
+it to a surviving worker, and aliases the old gateway job id to the
+new placement — the client never re-learns an id.
+
+QoS. Every submission passes the per-tenant admission controller
+(qos.py), whose thresholds are retuned each health tick from the live
+worker stats (queue depth/capacity, breaker state, warm-hit rate).
+Shed responses are ``kind="qos"`` with ``retry_after_s``.
+
+Streaming. The ``watch`` op forwards the worker's issue-event stream
+line by line (issue events as detection modules fire, one terminal
+``end`` event), with job ids rewritten to gateway ids.
+
+Transports. ``GatewayServer`` listens on TCP and sniffs each
+connection: an HTTP request line gets minimal HTTP/1.1 handling
+(``POST /api`` with a JSON body = one protocol request; ``GET
+/health|/stats|/metrics`` for probes; ``watch`` over POST streams
+``application/x-ndjson``); anything else is the raw line-JSON
+protocol, identical to a worker socket.
+"""
+
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from mythril_tpu.fleet.hashring import HashRing, code_key
+from mythril_tpu.fleet.qos import AdmissionController
+from mythril_tpu.fleet.transport import MAX_LINE_BYTES
+from mythril_tpu.obs import catalog as _cat
+
+log = logging.getLogger(__name__)
+
+# ops forwarded verbatim to a worker chosen by job id
+_JOB_OPS = ("status", "result", "cancel")
+# ops forwarded to the ring owner of a code hash
+_CODE_OPS = ("probe", "quarantine", "lift-quarantine")
+
+
+class Gateway:
+    """Protocol-level gateway: handles decoded request dicts."""
+
+    def __init__(
+        self,
+        workers,
+        admission: Optional[AdmissionController] = None,
+        replicas: int = 64,
+        request_timeout_s: float = 15.0,
+        health_interval_s: float = 2.0,
+    ):
+        self._workers = {w.name: w for w in workers}
+        if len(self._workers) != len(list(workers)):
+            raise ValueError("duplicate worker names")
+        self._alive = {name: True for name in self._workers}
+        self.ring = HashRing(self._workers, replicas=replicas)
+        self.admission = admission or AdmissionController()
+        self.request_timeout_s = request_timeout_s
+        self.health_interval_s = health_interval_s
+        self._lock = threading.RLock()
+        # gateway job id -> {"worker", "wid", "request"}; the kept
+        # request is what makes worker-death re-route possible
+        self._placements: Dict[str, Dict[str, Any]] = {}
+        self.started_at = time.time()
+        self.reroutes = 0
+        self.worker_deaths = 0
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        _cat.GATEWAY_WORKERS_ALIVE.set(len(self._workers))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        """Start the health/tuning loop (optional — tests drive
+        :meth:`health_tick` directly)."""
+        if self._health_thread is not None:
+            return
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="gateway-health", daemon=True
+        )
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self.health_tick()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("health tick failed")
+
+    def health_tick(self) -> Dict[str, Optional[Dict]]:
+        """One round of worker stats: revive answering dead workers,
+        mark unresponsive live ones dead, retune admission. Returns the
+        stats map (fleet_stats reuses it)."""
+        stats: Dict[str, Optional[Dict]] = {}
+        for name, worker in self._workers.items():
+            try:
+                response = worker.request({"op": "stats"}, timeout=5.0)
+                stats[name] = response if response.get("ok") else None
+            except (OSError, ValueError):
+                stats[name] = None
+        with self._lock:
+            for name, worker_stats in stats.items():
+                if worker_stats is None:
+                    self._mark_dead_locked(name)
+                elif not self._alive[name]:
+                    self._alive[name] = True
+                    self.ring.add(name)
+                    log.info("worker %s revived", name)
+            _cat.GATEWAY_WORKERS_ALIVE.set(
+                sum(1 for a in self._alive.values() if a)
+            )
+        self.admission.observe(stats)
+        return stats
+
+    def _mark_dead_locked(self, name: str) -> None:
+        if self._alive.get(name):
+            self._alive[name] = False
+            self.ring.remove(name)
+            self.worker_deaths += 1
+            _cat.GATEWAY_WORKER_DEATHS_TOTAL.inc()
+            _cat.GATEWAY_WORKERS_ALIVE.set(
+                sum(1 for a in self._alive.values() if a)
+            )
+            log.warning("worker %s marked dead", name)
+
+    def mark_dead(self, name: str) -> None:
+        with self._lock:
+            self._mark_dead_locked(name)
+
+    def alive_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, a in self._alive.items() if a)
+
+    # -------------------------------------------------------------- dispatch
+
+    def handle(self, request: Dict) -> Dict:
+        """One non-streaming request; never raises. ``watch`` goes
+        through :meth:`handle_stream`."""
+        op = request.get("op")
+        _cat.GATEWAY_REQUESTS_TOTAL.inc(1, str(op))
+        try:
+            if op == "ping":
+                return {"ok": True, "pong": True, "role": "gateway"}
+            if op == "workers":
+                with self._lock:
+                    return {
+                        "ok": True,
+                        "workers": {
+                            name: {"alive": self._alive[name]}
+                            for name in self._workers
+                        },
+                    }
+            if op == "submit":
+                return self._submit(request)
+            if op in _JOB_OPS:
+                return self._forward_job_op(request)
+            if op in _CODE_OPS:
+                return self._forward_code_op(request)
+            if op in ("stats", "fleet_stats"):
+                return self._fleet_stats()
+            if op == "health":
+                return self._fleet_health()
+            if op == "metrics":
+                return self._fleet_metrics()
+            if op == "shutdown":
+                return {"ok": True, "shutdown": True}
+            return {
+                "ok": False,
+                "kind": "bad-request",
+                "error": "unknown op %r" % op,
+            }
+        except (KeyError, TypeError, ValueError) as e:
+            return {"ok": False, "kind": "bad-request", "error": str(e),
+                    "retryable": False}
+        except Exception as e:  # pragma: no cover - defensive
+            log.exception("gateway request failed")
+            return {"ok": False, "kind": "internal", "error": str(e)}
+
+    # --------------------------------------------------------------- submit
+
+    def _submit(self, request: Dict) -> Dict:
+        tenant = str(request.get("tenant", "default"))
+        admitted, reason, retry_after = self.admission.admit(tenant)
+        if not admitted:
+            _cat.GATEWAY_SHED_TOTAL.inc()
+            return {
+                "ok": False,
+                "kind": "qos",
+                "error": "admission shed: %s" % reason,
+                "retryable": True,
+                "retry_after_s": retry_after,
+            }
+        key = code_key(
+            request.get("creation_code", ""), request.get("code", "")
+        )
+        forward = {k: v for k, v in request.items() if k != "tenant"}
+        backpressured: Optional[Dict] = None
+        for name in self._route_order(key):
+            response = self._try_worker(name, forward)
+            if response is None:
+                continue
+            if response.get("ok"):
+                gid = "%s:%s" % (name, response["job_id"])
+                with self._lock:
+                    self._placements[gid] = {
+                        "worker": name,
+                        "wid": response["job_id"],
+                        "request": forward,
+                    }
+                return {
+                    **response,
+                    "job_id": gid,
+                    "worker": name,
+                    "tenant": tenant,
+                }
+            if response.get("kind") == "backpressure":
+                # spill over: another worker may have queue room
+                backpressured = response
+                continue
+            return response  # admission / bad-request are authoritative
+        if backpressured is not None:
+            return backpressured
+        return {
+            "ok": False,
+            "kind": "no-workers",
+            "error": "no live worker could accept the submission",
+            "retryable": True,
+        }
+
+    def _route_order(self, key: bytes) -> List[str]:
+        with self._lock:
+            order = self.ring.route_order(key)
+            # dead-but-unremoved names can't appear (removal is atomic
+            # with _alive), but guard anyway
+            return [n for n in order if self._alive.get(n)]
+
+    def _try_worker(
+        self, name: str, payload: Dict, timeout: Optional[float] = None
+    ) -> Optional[Dict]:
+        """Forward to one worker; None (and a death mark) on transport
+        failure so the caller fails over."""
+        worker = self._workers[name]
+        try:
+            return worker.request(
+                payload, timeout=timeout or self.request_timeout_s
+            )
+        except (OSError, ValueError) as e:
+            log.warning("worker %s failed (%s): %s", name, type(e).__name__, e)
+            self.mark_dead(name)
+            return None
+
+    # ----------------------------------------------------------- job-scoped
+
+    @staticmethod
+    def _parse_gid(gid: Any) -> Tuple[str, int]:
+        name, sep, wid = str(gid).rpartition(":")
+        if not sep or not wid.lstrip("-").isdigit():
+            raise ValueError("malformed gateway job id %r" % gid)
+        return name, int(wid)
+
+    def _placement(self, gid: str) -> Dict[str, Any]:
+        with self._lock:
+            placement = self._placements.get(gid)
+        if placement is None:
+            # an id minted by a previous gateway incarnation: trust its
+            # embedded worker name but re-route is impossible (no kept
+            # request)
+            name, wid = self._parse_gid(gid)
+            if name not in self._workers:
+                raise KeyError("unknown job id %r" % gid)
+            placement = {"worker": name, "wid": wid, "request": None}
+        return placement
+
+    def _forward_job_op(self, request: Dict) -> Dict:
+        gid = str(request["job_id"])
+        placement = self._placement(gid)
+        payload = {**request, "job_id": placement["wid"]}
+        # a blocking `result` waits up to its own timeout on the worker;
+        # give the transport headroom past it or the gateway would kill
+        # healthy long-running jobs
+        timeout = self.request_timeout_s
+        if request.get("op") == "result" and request.get("timeout"):
+            timeout = max(timeout, float(request["timeout"]) + 5.0)
+        response = self._try_worker(placement["worker"], payload, timeout)
+        if response is None:
+            rerouted = self._reroute(gid, placement)
+            if rerouted is None:
+                return {
+                    "ok": False,
+                    "kind": "worker-dead",
+                    "error": "worker %s died and job %s could not be "
+                             "re-routed" % (placement["worker"], gid),
+                    "retryable": True,
+                }
+            payload = {**request, "job_id": rerouted["wid"]}
+            response = self._try_worker(rerouted["worker"], payload, timeout)
+            if response is None:
+                return {
+                    "ok": False,
+                    "kind": "worker-dead",
+                    "error": "re-routed worker died too",
+                    "retryable": True,
+                }
+        if response.get("ok") and "job_id" in response:
+            response = {**response, "job_id": gid}
+        return response
+
+    def _reroute(self, gid: str, placement: Dict) -> Optional[Dict]:
+        """The dead-worker path: resubmit the kept request to a
+        surviving worker and alias the gateway id to the new placement.
+        The durable store makes this cheap — a finished job warm-hits,
+        an unfinished one re-runs with warm memos."""
+        request = placement.get("request")
+        if request is None:
+            return None
+        key = code_key(
+            request.get("creation_code", ""), request.get("code", "")
+        )
+        for name in self._route_order(key):
+            if name == placement["worker"]:
+                continue
+            response = self._try_worker(name, request)
+            if response is not None and response.get("ok"):
+                new_placement = {
+                    "worker": name,
+                    "wid": response["job_id"],
+                    "request": request,
+                }
+                with self._lock:
+                    self._placements[gid] = new_placement
+                    self.reroutes += 1
+                _cat.GATEWAY_REROUTES_TOTAL.inc()
+                log.warning(
+                    "job %s re-routed %s -> %s",
+                    gid, placement["worker"], name,
+                )
+                return new_placement
+        return None
+
+    # ---------------------------------------------------------- code-scoped
+
+    def _forward_code_op(self, request: Dict) -> Dict:
+        target = request.get("worker")
+        if target is not None:
+            if target not in self._workers:
+                return {
+                    "ok": False,
+                    "kind": "bad-request",
+                    "error": "unknown worker %r" % target,
+                }
+            names = [str(target)]
+        else:
+            key = code_key(
+                request.get("creation_code", ""), request.get("code", "")
+            )
+            names = self._route_order(key)
+        payload = {k: v for k, v in request.items() if k != "worker"}
+        for name in names:
+            response = self._try_worker(name, payload)
+            if response is not None:
+                if response.get("ok"):
+                    response = {**response, "worker": name}
+                return response
+        return {
+            "ok": False,
+            "kind": "no-workers",
+            "error": "no live worker reachable",
+            "retryable": True,
+        }
+
+    # ------------------------------------------------------------ streaming
+
+    def handle_stream(self, request: Dict) -> Iterator[Dict]:
+        """The ``watch`` op: forward the owning worker's event stream,
+        rewriting job ids to gateway ids."""
+        _cat.GATEWAY_REQUESTS_TOTAL.inc(1, "watch")
+        try:
+            gid = str(request["job_id"])
+            placement = self._placement(gid)
+        except (KeyError, TypeError, ValueError) as e:
+            yield {"ok": False, "kind": "bad-request", "error": str(e)}
+            return
+        attempts = 2  # original placement, then one re-route
+        while attempts > 0:
+            attempts -= 1
+            worker = self._workers[placement["worker"]]
+            payload = {**request, "job_id": placement["wid"]}
+            try:
+                for event in worker.stream(
+                    payload, timeout=self.request_timeout_s
+                ):
+                    if "job_id" in event:
+                        event = {**event, "job_id": gid}
+                    _cat.GATEWAY_STREAM_EVENTS_TOTAL.inc()
+                    yield event
+                    if not event.get("ok") or event.get("event") == "end":
+                        return
+                return
+            except (OSError, ValueError) as e:
+                log.warning(
+                    "watch stream from %s failed: %s", placement["worker"], e
+                )
+                self.mark_dead(placement["worker"])
+                rerouted = self._reroute(gid, placement)
+                if rerouted is None or attempts == 0:
+                    yield {
+                        "ok": False,
+                        "kind": "worker-dead",
+                        "error": "stream lost: worker %s died"
+                                 % placement["worker"],
+                        "retryable": True,
+                    }
+                    return
+                placement = rerouted
+
+    # ----------------------------------------------------------- aggregates
+
+    def _worker_map(self, op: str) -> Dict[str, Optional[Dict]]:
+        out: Dict[str, Optional[Dict]] = {}
+        for name, worker in self._workers.items():
+            try:
+                response = worker.request({"op": op}, timeout=5.0)
+                out[name] = response if response.get("ok") else None
+            except (OSError, ValueError):
+                out[name] = None
+        return out
+
+    def gateway_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "workers": len(self._workers),
+                "workers_alive": sum(
+                    1 for a in self._alive.values() if a
+                ),
+                "worker_deaths": self.worker_deaths,
+                "reroutes": self.reroutes,
+                "placements": len(self._placements),
+                "uptime_s": round(time.time() - self.started_at, 3),
+            }
+
+    def _fleet_stats(self) -> Dict:
+        worker_stats = self._worker_map("stats")
+        self.admission.observe(worker_stats)
+        return {
+            "ok": True,
+            "gateway": self.gateway_stats(),
+            "admission": self.admission.snapshot(),
+            "workers": worker_stats,
+        }
+
+    def _fleet_health(self) -> Dict:
+        worker_health = self._worker_map("health")
+        healthy = bool(worker_health) and all(
+            h is not None and h.get("healthy") for h in worker_health.values()
+        )
+        return {
+            "ok": True,
+            "healthy": healthy,
+            "gateway": self.gateway_stats(),
+            "workers": worker_health,
+        }
+
+    def _fleet_metrics(self) -> Dict:
+        worker_metrics = {}
+        for name, response in self._worker_map("metrics").items():
+            worker_metrics[name] = (
+                response.get("metrics") if response else None
+            )
+        return {
+            "ok": True,
+            "metrics": _cat.GATEWAY_REGISTRY.render_prometheus(),
+            "workers": worker_metrics,
+        }
+
+
+class GatewayServer:
+    """TCP front: line-JSON protocol with HTTP sniffing per connection."""
+
+    def __init__(self, gateway: Gateway, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.gateway = gateway
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._sock.settimeout(0.5)
+        self.address = "%s:%d" % self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.serve_forever, name="gateway-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def serve_forever(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(
+                    target=self._serve_connection, args=(conn,), daemon=True
+                ).start()
+        finally:
+            self._sock.close()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        with conn:
+            conn.settimeout(30.0)
+            buf = bytearray()
+            try:
+                # sniff: enough bytes to tell HTTP from line-JSON
+                while len(buf) < 5 and b"\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf.extend(chunk)
+                head = bytes(buf[:5])
+                if head.startswith((b"GET ", b"POST ", b"HEAD ")):
+                    self._serve_http(conn, buf)
+                else:
+                    self._serve_lines(conn, buf)
+            except (OSError, ValueError):
+                return
+
+    def _serve_lines(self, conn: socket.socket, buf: bytearray) -> None:
+        wfile = conn.makefile("w", encoding="utf-8")
+
+        def write(response: Dict) -> None:
+            wfile.write(json.dumps(response) + "\n")
+            wfile.flush()
+
+        discarding = False
+        while True:
+            idx = buf.find(b"\n")
+            if idx < 0:
+                if len(buf) > MAX_LINE_BYTES:
+                    if not discarding:
+                        write({
+                            "ok": False,
+                            "kind": "bad-request",
+                            "error": "request line exceeds %d bytes"
+                                     % MAX_LINE_BYTES,
+                            "retryable": False,
+                        })
+                        discarding = True
+                    del buf[:]
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf.extend(chunk)
+                continue
+            raw = bytes(buf[:idx])
+            del buf[: idx + 1]
+            if discarding:
+                discarding = False
+                continue
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line:
+                continue
+            try:
+                request = json.loads(line)
+                if not isinstance(request, dict):
+                    raise ValueError("request must be a JSON object")
+            except (json.JSONDecodeError, ValueError) as e:
+                write({"ok": False, "kind": "bad-request", "error": str(e)})
+                continue
+            if request.get("op") == "watch":
+                for event in self.gateway.handle_stream(request):
+                    write(event)
+                continue
+            response = self.gateway.handle(request)
+            write(response)
+            if response.get("shutdown"):
+                self.stop()
+                return
+
+    # ---------------------------------------------------------------- http
+
+    def _serve_http(self, conn: socket.socket, buf: bytearray) -> None:
+        # headers, bounded
+        while b"\r\n\r\n" not in buf and b"\n\n" not in buf:
+            if len(buf) > 65536:
+                self._http_error(conn, 431, "headers too large")
+                return
+            chunk = conn.recv(65536)
+            if not chunk:
+                return
+            buf.extend(chunk)
+        raw = bytes(buf)
+        sep = b"\r\n\r\n" if b"\r\n\r\n" in raw else b"\n\n"
+        head, body = raw.split(sep, 1)
+        lines = head.decode("latin-1").splitlines()
+        try:
+            method, path, _ = lines[0].split(None, 2)
+        except ValueError:
+            self._http_error(conn, 400, "malformed request line")
+            return
+        headers = {}
+        for line in lines[1:]:
+            name, _, value = line.partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", "0") or "0")
+        if length > MAX_LINE_BYTES:
+            self._http_error(conn, 413, "body too large")
+            return
+        body = bytearray(body)
+        while len(body) < length:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return
+            body.extend(chunk)
+
+        if method == "GET":
+            if path in ("/health", "/stats", "/workers"):
+                op = path.lstrip("/")
+                op = "fleet_stats" if op == "stats" else op
+                self._http_json(conn, self.gateway.handle({"op": op}))
+            elif path == "/metrics":
+                response = self.gateway.handle({"op": "metrics"})
+                text = response.get("metrics", "") or ""
+                for name, worker_text in (
+                    response.get("workers") or {}
+                ).items():
+                    if worker_text:
+                        text += "\n# worker %s\n%s" % (name, worker_text)
+                self._http_raw(
+                    conn, 200, text.encode("utf-8"),
+                    "text/plain; version=0.0.4",
+                )
+            else:
+                self._http_error(conn, 404, "unknown path %s" % path)
+            return
+        if method == "POST":
+            try:
+                request = json.loads(bytes(body) or b"{}")
+                if not isinstance(request, dict):
+                    raise ValueError("request must be a JSON object")
+            except (json.JSONDecodeError, ValueError) as e:
+                self._http_json(
+                    conn,
+                    {"ok": False, "kind": "bad-request", "error": str(e)},
+                    status=400,
+                )
+                return
+            if path not in ("/", "/api"):
+                # path-addressed op: POST /submit == {"op": "submit"}
+                request.setdefault("op", path.lstrip("/"))
+            if request.get("op") == "watch":
+                self._http_stream(conn, request)
+                return
+            response = self.gateway.handle(request)
+            self._http_json(
+                conn, response, status=200 if response.get("ok") else 400
+            )
+            return
+        self._http_error(conn, 405, "method %s not allowed" % method)
+
+    def _http_stream(self, conn: socket.socket, request: Dict) -> None:
+        conn.sendall(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/x-ndjson\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        for event in self.gateway.handle_stream(request):
+            conn.sendall(json.dumps(event).encode("utf-8") + b"\n")
+
+    def _http_json(self, conn: socket.socket, payload: Dict,
+                   status: int = 200) -> None:
+        self._http_raw(
+            conn, status, json.dumps(payload).encode("utf-8"),
+            "application/json",
+        )
+
+    def _http_error(self, conn: socket.socket, status: int,
+                    message: str) -> None:
+        self._http_json(
+            conn, {"ok": False, "kind": "bad-request", "error": message},
+            status=status,
+        )
+
+    @staticmethod
+    def _http_raw(conn: socket.socket, status: int, body: bytes,
+                  content_type: str) -> None:
+        reasons = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                   405: "Method Not Allowed", 413: "Payload Too Large",
+                   431: "Request Header Fields Too Large"}
+        head = (
+            "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+            "Content-Length: %d\r\nConnection: close\r\n\r\n"
+            % (status, reasons.get(status, "Error"), content_type, len(body))
+        )
+        try:
+            conn.sendall(head.encode("latin-1") + body)
+        except OSError:
+            pass

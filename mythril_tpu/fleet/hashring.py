@@ -1,0 +1,97 @@
+"""Consistent-hash ring over keccak(code): the fleet's routing rule.
+
+Every submission routes by the SAME key the result cache uses —
+``keccak256(creation_code ‖ runtime_code)`` (service/cache.py) — so a
+duplicate deployment always lands on the worker that already holds the
+warm entry, and the durable store only has to cover the failover case
+(worker death re-routes the hash to the next node on the ring).
+
+Virtual nodes (``replicas`` points per worker) smooth the distribution;
+removal of a node only re-routes the keys that hashed to its points —
+the property that makes worker death cheap for the rest of the fleet.
+Device-free by construction (fleet_boundary lint rule): keccak here is
+the pure host engine from support/keccak.py.
+"""
+
+import bisect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mythril_tpu.support.keccak import keccak256
+
+
+def code_key(creation_hex: str, runtime_hex: str) -> bytes:
+    """The routing key — identical to service/cache.cache_key (keccak
+    over the exact submitted code bytes), duplicated here so the
+    gateway never imports the service package."""
+    creation = bytes.fromhex(creation_hex or "")
+    runtime = bytes.fromhex(runtime_hex or "")
+    return keccak256(creation + runtime)
+
+
+def _point(label: bytes) -> int:
+    return int.from_bytes(keccak256(label)[:8], "big")
+
+
+class HashRing:
+    """Sorted ring of virtual points; O(log n) routing via bisect."""
+
+    def __init__(self, nodes: Sequence[str] = (), replicas: int = 64):
+        self.replicas = replicas
+        self._points: List[Tuple[int, str]] = []
+        self._nodes: Dict[str, List[int]] = {}
+        for node in nodes:
+            self.add(node)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        points = [
+            _point(b"%s#%d" % (node.encode("utf-8"), i))
+            for i in range(self.replicas)
+        ]
+        self._nodes[node] = points
+        for p in points:
+            bisect.insort(self._points, (p, node))
+
+    def remove(self, node: str) -> None:
+        points = self._nodes.pop(node, None)
+        if points is None:
+            return
+        dead = set(points)
+        self._points = [
+            (p, n) for (p, n) in self._points
+            if not (n == node and p in dead)
+        ]
+
+    def nodes(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._nodes
+
+    def route(self, key: bytes) -> Optional[str]:
+        """The node owning ``key``, or None for an empty ring."""
+        order = self.route_order(key)
+        return order[0] if order else None
+
+    def route_order(self, key: bytes) -> List[str]:
+        """All nodes in ring order starting at ``key``'s successor —
+        the failover sequence: entry 0 is the owner, entry 1 takes over
+        if the owner is dead, and so on. Each node appears once."""
+        if not self._points:
+            return []
+        idx = bisect.bisect_right(self._points, (_point(key), "\uffff"))
+        order: List[str] = []
+        seen = set()
+        n = len(self._points)
+        for i in range(n):
+            node = self._points[(idx + i) % n][1]
+            if node not in seen:
+                seen.add(node)
+                order.append(node)
+                if len(order) == len(self._nodes):
+                    break
+        return order

@@ -1,0 +1,323 @@
+"""Chain-scan ingest: a block-explorer-shaped workload for the fleet.
+
+Real chain ingest is not a benchmark loop over one contract: it is a
+STREAM of deployments with heavy near-duplication (factory redeploys,
+forks, proxies differing only in constructor args or metadata). This
+module synthesizes that stream from the repo's bench corpus
+(bench_contracts/*.asm) and drives a gateway with it:
+
+  * each deployment is a corpus contract with a FRESH solidity
+    metadata trailer appended to its runtime (and its creation wrapper
+    rebuilt) — a unique keccak routing/cache key whose analysis is
+    byte-for-byte identical, because the disassembler strips metadata
+    (disassembler/asm.py) exactly as it does for real compiler output;
+  * with probability ``dup_rate`` the scanner re-submits a PREVIOUS
+    deployment verbatim instead — the warm-tier traffic that the
+    durable shared store should absorb across workers;
+  * a ``watch_fraction`` slice of submissions also opens a ``watch``
+    stream and records latency-to-first-issue — the fleet's "how fast
+    does an operator hear about a live bug" number;
+  * submissions are rate-limited client-side (``rate_per_s``); QoS
+    sheds are counted and retried after the server's ``retry_after_s``.
+
+Deterministic under a seed (the RNG drives corpus choice, dup choice,
+metadata bytes, and watch sampling). Device-free except for
+:func:`load_corpus`, which imports the (jax-free) assembler.
+"""
+
+import random
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from mythril_tpu.service.api import RequestTimeout
+
+#: swarm-hash metadata trailer: 0xa1 0x65 'bzzr0' 0x58 0x20 <32 bytes>
+#: <2-byte length 0x0029> — the exact shape solc <0.5.9 emits and the
+#: disassembler's metadata stripper recognizes.
+_METADATA_PREFIX = "a165627a7a72305820"
+_METADATA_SUFFIX = "0029"
+
+
+def load_corpus(
+    names: Optional[List[str]] = None,
+) -> List[Tuple[str, str, str]]:
+    """``(name, creation_hex, runtime_hex)`` for each bench contract."""
+    import os
+
+    from mythril_tpu.disassembler.asm import assemble
+
+    root = os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        "bench_contracts",
+    )
+    if names is None:
+        names = sorted(
+            f[:-4] for f in os.listdir(root) if f.endswith(".asm")
+        )
+    corpus = []
+    for name in names:
+        with open(os.path.join(root, name + ".asm")) as f:
+            runtime = assemble(f.read()).hex()
+        corpus.append((name, _creation_for(runtime), runtime))
+    return corpus
+
+
+def _creation_for(runtime_hex: str) -> str:
+    """A deploy wrapper (CODECOPY + RETURN) around a runtime blob."""
+    from mythril_tpu.disassembler.asm import assemble
+
+    n = len(runtime_hex) // 2
+    return (
+        assemble(
+            "PUSH2 %d\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\n"
+            "PUSH2 %d\nPUSH1 0x00\nRETURN\ncode:" % (n, n)
+        ).hex()
+        + runtime_hex
+    )
+
+
+def mutate_deployment(
+    creation_hex: str, runtime_hex: str, rng: random.Random
+) -> Tuple[str, str]:
+    """A semantics-identical redeploy: fresh metadata trailer, fresh
+    keccak. The creation wrapper is rebuilt because the runtime length
+    it embeds changed."""
+    trailer = (
+        _METADATA_PREFIX
+        + "".join("%02x" % rng.randrange(256) for _ in range(32))
+        + _METADATA_SUFFIX
+    )
+    mutated_runtime = runtime_hex + trailer
+    return _creation_for(mutated_runtime), mutated_runtime
+
+
+class InProcClient:
+    """Adapt a :class:`~mythril_tpu.fleet.gateway.Gateway` object to
+    the worker-handle request/stream contract, for in-process tests."""
+
+    def __init__(self, gateway):
+        self.gateway = gateway
+
+    def request(self, payload: Dict, timeout: Optional[float] = None) -> Dict:
+        return self.gateway.handle(payload)
+
+    def stream(
+        self, payload: Dict, timeout: Optional[float] = None
+    ) -> Iterator[Dict]:
+        return self.gateway.handle_stream(payload)
+
+
+class ChainScan:
+    """Drive one synthetic chain-scan against a gateway client
+    (:class:`~mythril_tpu.fleet.worker.SocketWorker` for a real TCP
+    gateway, :class:`InProcClient` for tests)."""
+
+    def __init__(
+        self,
+        client,
+        corpus: Optional[List[Tuple[str, str, str]]] = None,
+        seed: int = 1337,
+        dup_rate: float = 0.4,
+        rate_per_s: float = 0.0,
+        watch_fraction: float = 0.25,
+        tenant: str = "chain-scan",
+        tx_count: int = 2,
+        timeout: int = 60,
+        max_depth: int = 64,
+        result_timeout_s: float = 300.0,
+    ):
+        self.client = client
+        self.corpus = corpus if corpus is not None else load_corpus()
+        if not self.corpus:
+            raise ValueError("empty corpus")
+        self.rng = random.Random(seed)
+        self.dup_rate = dup_rate
+        self.rate_per_s = rate_per_s
+        self.watch_fraction = watch_fraction
+        self.tenant = tenant
+        self.tx_count = tx_count
+        self.timeout = timeout
+        self.max_depth = max_depth
+        self.result_timeout_s = result_timeout_s
+        # every deployment this scan has emitted (dups re-draw from it)
+        self._seen: List[Tuple[str, str, str]] = []
+        self.records: List[Dict[str, Any]] = []
+        self.first_issue_latencies: List[float] = []
+        self.sheds = 0
+        self.failures = 0
+
+    # ----------------------------------------------------------- the scan
+
+    def next_deployment(self) -> Tuple[str, str, str, bool]:
+        """(name, creation_hex, runtime_hex, is_dup) for the next block."""
+        if self._seen and self.rng.random() < self.dup_rate:
+            name, creation, runtime = self._seen[
+                self.rng.randrange(len(self._seen))
+            ]
+            return name, creation, runtime, True
+        base_name, creation, runtime = self.corpus[
+            self.rng.randrange(len(self.corpus))
+        ]
+        creation, runtime = mutate_deployment(creation, runtime, self.rng)
+        name = "%s-%04d" % (base_name, len(self._seen))
+        self._seen.append((name, creation, runtime))
+        return name, creation, runtime, False
+
+    def run(self, n_contracts: int) -> Dict[str, Any]:
+        """Scan ``n_contracts`` deployments to completion; returns the
+        summary (also available as :meth:`summary`)."""
+        started = time.monotonic()
+        next_slot = started
+        for _ in range(n_contracts):
+            if self.rate_per_s > 0:
+                now = time.monotonic()
+                if now < next_slot:
+                    time.sleep(next_slot - now)
+                next_slot = max(next_slot, now) + 1.0 / self.rate_per_s
+            self._scan_one()
+        return self.summary(time.monotonic() - started)
+
+    def _scan_one(self) -> None:
+        name, creation, runtime, is_dup = self.next_deployment()
+        submit = {
+            "op": "submit",
+            "name": name,
+            "code": runtime,
+            "creation_code": creation,
+            "tx_count": self.tx_count,
+            "timeout": self.timeout,
+            "max_depth": self.max_depth,
+            "tenant": self.tenant,
+        }
+        t0 = time.monotonic()
+        response = self._submit_with_backoff(submit)
+        if response is None:
+            self.failures += 1
+            self.records.append(
+                {"name": name, "dup": is_dup, "ok": False, "error": "shed"}
+            )
+            return
+        gid = response["job_id"]
+        watcher = None
+        if self.rng.random() < self.watch_fraction:
+            watcher = _FirstIssueWatcher(self.client, gid, t0)
+            watcher.start()
+        try:
+            result = self.client.request(
+                {"op": "result", "job_id": gid, "timeout": self.timeout + 30},
+                timeout=self.result_timeout_s,
+            )
+        except (OSError, ValueError) as e:
+            self.failures += 1
+            self.records.append(
+                {"name": name, "dup": is_dup, "ok": False, "error": str(e)}
+            )
+            return
+        wall = time.monotonic() - t0
+        if watcher is not None:
+            watcher.join(timeout=5.0)
+            if watcher.first_issue_s is not None:
+                self.first_issue_latencies.append(watcher.first_issue_s)
+        record = {
+            "name": name,
+            "dup": is_dup,
+            "ok": bool(result.get("ok")) and result.get("state") == "done",
+            "wall_s": round(wall, 4),
+            "cache_hit": bool(result.get("cache_hit")),
+            "worker": response.get("worker"),
+            "issues": len((result.get("result") or {}).get("issues") or []),
+        }
+        if not record["ok"]:
+            self.failures += 1
+            record["error"] = result.get("error")
+        self.records.append(record)
+
+    def _submit_with_backoff(
+        self, submit: Dict, max_attempts: int = 5
+    ) -> Optional[Dict]:
+        for _ in range(max_attempts):
+            try:
+                response = self.client.request(submit, timeout=15.0)
+            except (OSError, ValueError):
+                time.sleep(0.2)
+                continue
+            if response.get("ok"):
+                return response
+            if response.get("kind") in ("qos", "backpressure"):
+                self.sheds += 1
+                time.sleep(
+                    min(2.0, float(response.get("retry_after_s") or 0.25))
+                )
+                continue
+            return None
+        return None
+
+    # ------------------------------------------------------------ summary
+
+    def summary(self, elapsed_s: float) -> Dict[str, Any]:
+        done = [r for r in self.records if r.get("ok")]
+        walls = sorted(r["wall_s"] for r in done)
+        dups = [r for r in done if r["dup"]]
+        warm = [r for r in done if r.get("cache_hit")]
+        summary = {
+            "submitted": len(self.records),
+            "completed": len(done),
+            "failures": self.failures,
+            "sheds": self.sheds,
+            "elapsed_s": round(elapsed_s, 3),
+            "contracts_per_hour": (
+                round(3600.0 * len(done) / elapsed_s, 1) if elapsed_s else 0.0
+            ),
+            "p50_wall_s": _pct(walls, 0.50),
+            "p95_wall_s": _pct(walls, 0.95),
+            "dup_submissions": len(dups),
+            "warm_hits": len(warm),
+            "warm_hit_rate": (
+                round(len(warm) / len(dups), 4) if dups else None
+            ),
+            "watched": len(self.first_issue_latencies),
+            "p50_first_issue_s": _pct(
+                sorted(self.first_issue_latencies), 0.50
+            ),
+        }
+        return summary
+
+
+class _FirstIssueWatcher(threading.Thread):
+    """Open a watch stream and record time-to-first-issue-event."""
+
+    def __init__(self, client, job_id, t0: float):
+        super().__init__(name="chain-scan-watch", daemon=True)
+        self.client = client
+        self.job_id = job_id
+        self.t0 = t0
+        self.first_issue_s: Optional[float] = None
+        self.events = 0
+
+    def run(self) -> None:
+        try:
+            for event in self.client.stream(
+                {"op": "watch", "job_id": self.job_id}, timeout=120.0
+            ):
+                if not event.get("ok"):
+                    return
+                self.events += 1
+                if (
+                    event.get("event") == "issue"
+                    and self.first_issue_s is None
+                ):
+                    self.first_issue_s = round(time.monotonic() - self.t0, 4)
+                if event.get("event") == "end":
+                    return
+        except (RequestTimeout, OSError, ValueError):
+            return
+
+
+def _pct(sorted_values: List[float], q: float) -> Optional[float]:
+    if not sorted_values:
+        return None
+    idx = min(len(sorted_values) - 1, int(q * len(sorted_values)))
+    return round(sorted_values[idx], 4)

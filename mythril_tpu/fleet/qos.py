@@ -1,0 +1,156 @@
+"""Per-tenant QoS: token-bucket admission auto-tuned from live metrics.
+
+The in-process tier's knobs (queue size, worker count) are static
+configuration. At fleet scale the correct admission rate is a function
+of LIVE state — how deep the worker queues are, whether the circuit
+breaker is open, how much of the traffic the warm tier is absorbing —
+so the controller re-derives its thresholds from the PR 9 metrics the
+gateway already scrapes (scheduler stats: queue depth + capacity,
+breaker state, result-cache hit/miss counters) instead of env knobs:
+
+  * every tenant gets a token bucket; the REFILL RATE is
+    ``base_rate * level`` where ``level`` is retuned on every
+    :meth:`observe` from worker stats;
+  * queue pressure (max over workers of depth/capacity) scales the
+    level down linearly — full queues mean admission is the only
+    backpressure left;
+  * an OPEN breaker anywhere clamps the level to ``floor_level``:
+    the fleet is degraded, shed early rather than time out late;
+  * the cross-fleet warm-hit rate scales the level UP (up to 2x):
+    warm traffic is nearly free, so a dedup-heavy workload may be
+    admitted far above the cold-analysis rate.
+
+Shed responses carry ``retry_after_s`` so clients back off instead of
+hammering. Device-free (fleet_boundary contract).
+"""
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class TokenBucket:
+    """Classic token bucket; monotonic-clock refill."""
+
+    def __init__(self, rate_per_s: float, burst: float):
+        self.rate_per_s = rate_per_s
+        self.burst = burst
+        self.tokens = burst
+        self._last = time.monotonic()
+
+    def try_take(self, rate_scale: float = 1.0) -> Tuple[bool, float]:
+        """(admitted, retry_after_s). Refills at rate*scale."""
+        now = time.monotonic()
+        rate = max(1e-6, self.rate_per_s * rate_scale)
+        self.tokens = min(
+            self.burst, self.tokens + (now - self._last) * rate
+        )
+        self._last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True, 0.0
+        return False, (1.0 - self.tokens) / rate
+
+
+class AdmissionController:
+    """Tenant admission for the gateway; thread-safe."""
+
+    def __init__(
+        self,
+        base_rate_per_s: float = 8.0,
+        burst: float = 16.0,
+        floor_level: float = 0.05,
+        warm_boost_max: float = 1.0,
+    ):
+        self.base_rate_per_s = base_rate_per_s
+        self.burst = burst
+        self.floor_level = floor_level
+        self.warm_boost_max = warm_boost_max
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, TokenBucket] = {}
+        # auto-tuned multiplier on every tenant's refill rate
+        self.level = 1.0
+        self.queue_pressure = 0.0
+        self.warm_rate = 0.0
+        self.breaker_open = False
+        self.admitted = 0
+        self.shed = 0
+        self.observations = 0
+
+    # ------------------------------------------------------------- tuning
+
+    def observe(self, worker_stats: Dict[str, Optional[Dict]]) -> float:
+        """Retune the admission level from one round of live worker
+        stats (``name -> stats dict`` as returned by the service
+        ``stats`` op; None for an unreachable worker counts as full
+        pressure). Returns the new level."""
+        pressure = 0.0
+        breaker_open = False
+        hits = misses = 0.0
+        any_stats = False
+        for stats in worker_stats.values():
+            if not stats:
+                pressure = 1.0
+                continue
+            any_stats = True
+            capacity = float(stats.get("queue_size") or 16)
+            depth = float(stats.get("queued") or 0)
+            pressure = max(pressure, min(1.0, depth / max(1.0, capacity)))
+            if stats.get("breaker_state") not in (None, "closed"):
+                breaker_open = True
+            cache = stats.get("cache") or {}
+            hits += float(cache.get("hits", 0))
+            misses += float(cache.get("misses", 0))
+        if not any_stats and not worker_stats:
+            # nothing to observe: keep the current level
+            return self.level
+        warm_rate = hits / (hits + misses) if (hits + misses) else 0.0
+        level = (1.0 - pressure) * (1.0 + self.warm_boost_max * warm_rate)
+        if breaker_open:
+            level = min(level, self.floor_level)
+        with self._lock:
+            self.queue_pressure = pressure
+            self.warm_rate = warm_rate
+            self.breaker_open = breaker_open
+            self.level = max(self.floor_level, min(2.0, level))
+            self.observations += 1
+            return self.level
+
+    # ---------------------------------------------------------- admission
+
+    def admit(self, tenant: str = "default") -> Tuple[bool, Optional[str], float]:
+        """(admitted, shed reason, retry_after_s) for one submission."""
+        with self._lock:
+            bucket = self._tenants.get(tenant)
+            if bucket is None:
+                bucket = self._tenants[tenant] = TokenBucket(
+                    self.base_rate_per_s, self.burst
+                )
+            ok, retry_after = bucket.try_take(self.level)
+            if ok:
+                self.admitted += 1
+                return True, None, 0.0
+            self.shed += 1
+            if self.breaker_open:
+                reason = "fleet degraded (circuit breaker open)"
+            elif self.queue_pressure >= 0.75:
+                reason = (
+                    "worker queues at %.0f%% capacity"
+                    % (100.0 * self.queue_pressure)
+                )
+            else:
+                reason = "tenant %r over admitted rate" % tenant
+            return False, reason, round(retry_after, 3)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "level": round(self.level, 4),
+                "queue_pressure": round(self.queue_pressure, 4),
+                "warm_rate": round(self.warm_rate, 4),
+                "breaker_open": self.breaker_open,
+                "admitted": self.admitted,
+                "shed": self.shed,
+                "observations": self.observations,
+                "tenants": sorted(self._tenants),
+            }

@@ -1,0 +1,488 @@
+"""Durable shared warm store: append-log + index segments on disk.
+
+LevelDB-style shape (the reference leans on plyvel/LevelDB for its
+chain database — PAPER.md §1), pared down to what the fleet's warm
+tier needs:
+
+  * each WRITER process owns one append-only log file
+    (``wal.<pid>-<n>.log``) of CRC-framed records — per-process logs
+    sidestep cross-process append interleaving entirely;
+  * an index segment (``ckpt.<pid>-<n>.pkl``, written atomically via
+    rename) periodically snapshots the merged table plus the log
+    offsets it covers, so reopening is snapshot + log-TAIL replay, not
+    a full-history scan;
+  * recovery is replay: a torn final record (kill -9 mid-append, torn
+    header, bad CRC) drops THAT record and everything the log holds
+    before it is intact — the crash-recovery property test asserts
+    byte-identical survival of all complete records;
+  * cross-process sharing is :meth:`DurableStore.refresh`: re-scan
+    sibling logs for bytes appended since the last look and replay
+    them into the in-memory table.
+
+Record kinds and merge semantics (the ``value`` dicts carry a wall
+timestamp ``t`` where ordering matters):
+
+  ("result", code_hex)        finished report entry — latest-``t`` wins
+  ("memo", (code_hex, ver))   solver verdict dicts — set-union merge,
+                              keyed WITH ``FACT_SCHEMA_VERSION`` so a
+                              schema bump misses instead of resurrecting
+  ("quar", code_hex)          full quarantine state snapshot (strikes,
+                              last report, reason) — latest-``t`` wins
+
+:class:`DurableResultCache` plugs the store behind the EXISTING
+``ResultCache`` interface (get/put, get_solver_memo/put_solver_memo,
+record_crash/record_success/lift_quarantine/force_quarantine), so the
+scheduler does not change: a worker constructed with ``--store DIR``
+simply finds that reports, memos and quarantine strikes survive
+restarts and appear in sibling workers.
+
+Device-free by contract (fleet_boundary lint rule): this module runs
+inside the gateway's process space in tests and must import neither
+jax nor the laser stack.
+"""
+
+import glob
+import itertools
+import os
+import pickle
+import struct
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from mythril_tpu.service.cache import CacheEntry, ResultCache
+
+MAGIC = b"MYW1"
+_HEADER = struct.Struct("<4sII")  # magic, crc32(payload), payload length
+
+# one writer process can open several stores (tests); the sequence
+# keeps their log filenames distinct
+_WRITER_SEQ = itertools.count(1)
+
+RecordKey = Tuple[str, Any]
+
+
+class DurableStore:
+    """The raw log+segments layer; thread-safe. Values must pickle."""
+
+    def __init__(
+        self,
+        root: str,
+        fsync: bool = False,
+        checkpoint_every: int = 64,
+    ):
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        self.fsync = fsync
+        self.checkpoint_every = checkpoint_every
+        self._lock = threading.RLock()
+        self._writer_tag = "%d-%d" % (os.getpid(), next(_WRITER_SEQ))
+        self._wal_name = "wal.%s.log" % self._writer_tag
+        self._ckpt_path = os.path.join(self.root, "ckpt.%s.pkl" % self._writer_tag)
+        # merged view of every log seen so far: (kind, key) -> value
+        self._table: Dict[RecordKey, Any] = {}
+        # per-log replay offsets (basename -> byte offset fully applied)
+        self._offsets: Dict[str, int] = {}
+        self.appends = 0
+        self.replayed = 0
+        self.refreshes = 0
+        self.checkpoints = 0
+        self.torn_records = 0
+        self._since_checkpoint = 0
+        self._load()
+        self._wal = open(os.path.join(self.root, self._wal_name), "ab")
+        self._offsets.setdefault(self._wal_name, 0)
+
+    # ------------------------------------------------------------- write path
+
+    def append(self, kind: str, key: Any, value: Any) -> None:
+        payload = pickle.dumps(
+            (kind, key, value), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        frame = _HEADER.pack(
+            MAGIC, zlib.crc32(payload) & 0xFFFFFFFF, len(payload)
+        ) + payload
+        with self._lock:
+            self._wal.write(frame)
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            self._offsets[self._wal_name] += len(frame)
+            self._apply((kind, key, value))
+            self.appends += 1
+            self._since_checkpoint += 1
+            if self._since_checkpoint >= self.checkpoint_every:
+                self.checkpoint()
+
+    def checkpoint(self) -> None:
+        """Write this writer's index segment: the merged table plus the
+        per-log offsets it covers. Atomic (tmp + rename), so a segment
+        on disk is never torn — a crash mid-checkpoint leaves the
+        previous segment, and replay fills the gap from the logs."""
+        with self._lock:
+            tmp = self._ckpt_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(
+                    {"offsets": dict(self._offsets), "table": self._table},
+                    f,
+                    protocol=pickle.HIGHEST_PROTOCOL,
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._ckpt_path)
+            self._since_checkpoint = 0
+            self.checkpoints += 1
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self.checkpoint()
+            finally:
+                self._wal.close()
+
+    # -------------------------------------------------------------- read path
+
+    def get(self, kind: str, key: Any) -> Optional[Any]:
+        with self._lock:
+            return self._table.get((kind, key))
+
+    def items(self, kind: Optional[str] = None) -> List[Tuple[RecordKey, Any]]:
+        with self._lock:
+            return [
+                (rk, v)
+                for rk, v in self._table.items()
+                if kind is None or rk[0] == kind
+            ]
+
+    def refresh(self) -> List[Tuple[str, Any, Any]]:
+        """Replay bytes sibling processes appended since the last look;
+        returns the records applied (the cache layer uses them to
+        hydrate with 'peer' provenance). Cheap when nothing changed:
+        one directory scan + size compares."""
+        applied: List[Tuple[str, Any, Any]] = []
+        with self._lock:
+            for path in self._log_paths():
+                name = os.path.basename(path)
+                if name == self._wal_name:
+                    continue
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                offset = self._offsets.get(name, 0)
+                if size < offset:
+                    # sibling compacted/rewrote its log: start over
+                    offset = self._offsets[name] = 0
+                if size > offset:
+                    applied.extend(self._replay(path, offset))
+            self.refreshes += 1
+        return applied
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            disk = 0
+            for pattern in ("wal.*.log", "ckpt.*.pkl"):
+                for path in glob.glob(os.path.join(self.root, pattern)):
+                    try:
+                        disk += os.path.getsize(path)
+                    except OSError:
+                        pass
+            return {
+                "records": len(self._table),
+                "appends": self.appends,
+                "replayed": self.replayed,
+                "refreshes": self.refreshes,
+                "checkpoints": self.checkpoints,
+                "torn_records": self.torn_records,
+                "logs": len(self._log_paths()),
+                "disk_bytes": disk,
+            }
+
+    # -------------------------------------------------------------- internals
+
+    def _log_paths(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.root, "wal.*.log")))
+
+    def _apply(self, record: Tuple[str, Any, Any]) -> None:
+        kind, key, value = record
+        slot = (kind, key)
+        if kind == "memo":
+            current = self._table.get(slot)
+            if current:
+                merged = dict(current)
+                merged.update(value)
+                self._table[slot] = merged
+            else:
+                self._table[slot] = dict(value)
+        else:
+            current = self._table.get(slot)
+            if current is None or not isinstance(current, dict) or (
+                value.get("t", 0.0) >= current.get("t", 0.0)
+            ):
+                self._table[slot] = value
+
+    def _replay(self, path: str, offset: int) -> List[Tuple[str, Any, Any]]:
+        """Apply complete records from ``path`` starting at ``offset``.
+        Stops (and drops the tail) at the first torn or corrupt frame —
+        the kill-9 recovery contract."""
+        applied: List[Tuple[str, Any, Any]] = []
+        name = os.path.basename(path)
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return applied
+        with f:
+            f.seek(offset)
+            while True:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    if header:
+                        self.torn_records += 1
+                    break
+                magic, crc, length = _HEADER.unpack(header)
+                if magic != MAGIC:
+                    self.torn_records += 1
+                    break
+                payload = f.read(length)
+                if len(payload) < length:
+                    self.torn_records += 1
+                    break
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    self.torn_records += 1
+                    break
+                try:
+                    record = pickle.loads(payload)
+                    kind, key, value = record
+                except Exception:
+                    self.torn_records += 1
+                    break
+                self._apply(record)
+                applied.append(record)
+                offset += _HEADER.size + length
+                self.replayed += 1
+        self._offsets[name] = offset
+        return applied
+
+    def _load(self) -> None:
+        """Open-time recovery: newest readable index segment (any
+        writer's), then tail-replay every log from the offsets it
+        covers. Unreadable/torn segments are skipped — the logs are
+        the source of truth."""
+        segments = sorted(
+            glob.glob(os.path.join(self.root, "ckpt.*.pkl")),
+            key=lambda p: os.path.getmtime(p),
+            reverse=True,
+        )
+        for path in segments:
+            try:
+                with open(path, "rb") as f:
+                    data = pickle.load(f)
+                self._table = dict(data["table"])
+                self._offsets = {
+                    name: off
+                    for name, off in data["offsets"].items()
+                    if os.path.exists(os.path.join(self.root, name))
+                }
+                break
+            except Exception:
+                continue
+        for path in self._log_paths():
+            name = os.path.basename(path)
+            self._replay(path, self._offsets.get(name, 0))
+
+
+class DurableResultCache(ResultCache):
+    """ResultCache backed by a :class:`DurableStore`.
+
+    Reads hydrate from disk at open and from sibling processes on a
+    throttled :meth:`refresh`; every mutation appends a durable record
+    after updating the in-memory state. Static-pass tables stay
+    memory-only (they re-derive from code bytes in milliseconds and do
+    not pickle compactly); everything else — reports, solver memos,
+    quarantine — survives restarts and is shared cross-process.
+    """
+
+    def __init__(
+        self,
+        store_dir: str,
+        max_entries: int = 256,
+        fsync: bool = False,
+        checkpoint_every: int = 64,
+        refresh_interval_s: float = 0.05,
+    ):
+        super().__init__(max_entries=max_entries)
+        self.store = DurableStore(
+            store_dir, fsync=fsync, checkpoint_every=checkpoint_every
+        )
+        self.refresh_interval_s = refresh_interval_s
+        self._last_refresh = 0.0
+        # hits served from entries ANOTHER process/incarnation computed
+        # ('disk' = present at open, 'peer' = replayed live): the
+        # fleet's cross-process warm-hit acceptance counter
+        self.cross_process_hits = 0
+        with self._lock:
+            for (kind, key), value in self.store.items():
+                self._hydrate(kind, key, value, origin="disk")
+
+    # ------------------------------------------------------------ hydration
+
+    def _hydrate(self, kind: str, key: Any, value: Any, origin: str) -> None:
+        """Apply one store record to the in-memory structures. Caller
+        holds ``self._lock``."""
+        if kind == "result":
+            entry = CacheEntry(
+                tuple(value["params"]),
+                value["issues"],
+                value["swc_ids"],
+                value["cold_wall_s"],
+            )
+            entry.origin = origin
+            code_hash = bytes.fromhex(key)
+            self._entries[code_hash] = entry
+            self._entries.move_to_end(code_hash)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        elif kind == "memo":
+            code_hex, schema = key
+            mkey = (bytes.fromhex(code_hex), schema)
+            entry = self._solver_memos.get(mkey)
+            if entry is None:
+                entry = OrderedDict()
+                self._solver_memos[mkey] = entry
+            entry.update(value)
+            self._solver_memos.move_to_end(mkey)
+            while len(self._solver_memos) > self.solver_memo_max:
+                self._solver_memos.popitem(last=False)
+                self.solver_memo_evictions += 1
+        elif kind == "quar":
+            code_hash = bytes.fromhex(key)
+            strikes = int(value.get("strikes", 0))
+            if strikes > 0:
+                self._crash_strikes[code_hash] = strikes
+            else:
+                self._crash_strikes.pop(code_hash, None)
+            report = value.get("report")
+            if report:
+                self._crash_reports[code_hash] = dict(report)
+            else:
+                self._crash_reports.pop(code_hash, None)
+            reason = value.get("quarantined")
+            if reason:
+                self._quarantined[code_hash] = reason
+            else:
+                self._quarantined.pop(code_hash, None)
+
+    def refresh(self, force: bool = False) -> int:
+        """Pull sibling processes' appends into memory (throttled to
+        one directory scan per ``refresh_interval_s``); returns the
+        number of records applied."""
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.refresh_interval_s:
+            return 0
+        self._last_refresh = now
+        applied = self.store.refresh()
+        if applied:
+            with self._lock:
+                for kind, key, value in applied:
+                    self._hydrate(kind, key, value, origin="peer")
+        return len(applied)
+
+    # ------------------------------------------------------- cache overrides
+
+    def get(self, key, tx_count, modules=None, timeout=None):
+        self.refresh()
+        entry = super().get(key, tx_count, modules, timeout)
+        if entry is not None and getattr(entry, "origin", "local") != "local":
+            with self._lock:
+                self.cross_process_hits += 1
+        return entry
+
+    def put(
+        self,
+        key,
+        tx_count,
+        modules,
+        timeout,
+        issues,
+        swc_ids,
+        cold_wall_s,
+        static_tables=None,
+    ):
+        entry = super().put(
+            key, tx_count, modules, timeout, issues, swc_ids,
+            cold_wall_s, static_tables=static_tables,
+        )
+        self.store.append(
+            "result",
+            key.hex(),
+            {
+                "params": entry.params,
+                "issues": issues,
+                "swc_ids": swc_ids,
+                "cold_wall_s": cold_wall_s,
+                "t": time.time(),
+            },
+        )
+        return entry
+
+    def get_solver_memo(self, key):
+        self.refresh()
+        return super().get_solver_memo(key)
+
+    def put_solver_memo(self, key, memo):
+        if not memo:
+            return
+        super().put_solver_memo(key, memo)
+        code_hash, schema = self._memo_key(key)
+        self.store.append("memo", (code_hash.hex(), schema), dict(memo))
+
+    # -------------------------------------------------- quarantine overrides
+
+    def _append_quarantine_state(self, key) -> None:
+        with self._lock:
+            value = {
+                "strikes": self._crash_strikes.get(key, 0),
+                "report": self._crash_reports.get(key),
+                "quarantined": self._quarantined.get(key),
+                "t": time.time(),
+            }
+        self.store.append("quar", key.hex(), value)
+
+    def record_crash(self, key, report=None):
+        strikes = super().record_crash(key, report)
+        self._append_quarantine_state(key)
+        return strikes
+
+    def record_success(self, key):
+        super().record_success(key)
+        self._append_quarantine_state(key)
+
+    def lift_quarantine(self, key):
+        lifted = super().lift_quarantine(key)
+        self._append_quarantine_state(key)
+        return lifted
+
+    def force_quarantine(self, key, reason):
+        super().force_quarantine(key, reason)
+        self._append_quarantine_state(key)
+
+    def is_quarantined(self, key):
+        self.refresh()
+        return super().is_quarantined(key)
+
+    def quarantine_reason(self, key):
+        self.refresh()
+        return super().quarantine_reason(key)
+
+    # ---------------------------------------------------------------- admin
+
+    def stats(self):
+        base = super().stats()
+        base["store"] = self.store.stats()
+        base["cross_process_hits"] = self.cross_process_hits
+        return base
+
+    def close(self) -> None:
+        self.store.close()

@@ -1,0 +1,115 @@
+"""Fleet transport plumbing: addresses + bounded line-JSON clients.
+
+One address grammar covers both hops of the fleet:
+
+  * ``host:port``    — TCP (the gateway's public face)
+  * ``unix:PATH``    — explicit Unix domain socket
+  * anything with a path separator or no colon — a Unix socket path
+    (so existing ``myth serve --socket /tmp/x.sock`` values just work)
+
+The line protocol is the service one (service/api.py): one JSON object
+per line in, one (or, for ``watch``, several) per line out. Reads are
+bounded by ``MAX_LINE_BYTES`` — the client-side mirror of the server's
+oversized-request defense. Device-free (fleet_boundary contract).
+"""
+
+import json
+import socket
+from typing import Dict, Iterator, Optional, Tuple, Union
+
+from mythril_tpu.service.api import RequestTimeout
+
+MAX_LINE_BYTES = 4 << 20
+
+Address = Union[str, Tuple[str, int]]
+
+
+def parse_address(address: str) -> Tuple[int, Address]:
+    """(socket family, connect arg) for an address string."""
+    if address.startswith("unix:"):
+        return socket.AF_UNIX, address[5:]
+    if ":" in address and "/" not in address and "\\" not in address:
+        host, _, port = address.rpartition(":")
+        return socket.AF_INET, (host or "127.0.0.1", int(port))
+    return socket.AF_UNIX, address
+
+
+def connect(address: str, timeout: Optional[float] = None) -> socket.socket:
+    family, target = parse_address(address)
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    try:
+        sock.connect(target)
+    except BaseException:
+        sock.close()
+        raise
+    return sock
+
+
+def read_line(sock: socket.socket, buf: bytearray) -> Optional[bytes]:
+    """One newline-terminated line from ``sock`` using ``buf`` as the
+    carry-over buffer; None on EOF. Raises ConnectionError if a line
+    exceeds MAX_LINE_BYTES (a broken or hostile peer)."""
+    while True:
+        idx = buf.find(b"\n")
+        if idx >= 0:
+            line = bytes(buf[:idx])
+            del buf[: idx + 1]
+            return line
+        if len(buf) > MAX_LINE_BYTES:
+            raise ConnectionError(
+                "peer line exceeds %d bytes" % MAX_LINE_BYTES
+            )
+        chunk = sock.recv(65536)
+        if not chunk:
+            return None
+        buf.extend(chunk)
+
+
+def request(
+    address: str, payload: Dict, timeout: Optional[float] = None
+) -> Dict:
+    """One request, one response. socket.timeout surfaces as
+    :class:`RequestTimeout` (``retryable=True``); connection failures
+    surface as ConnectionError/OSError for the caller's failover."""
+    try:
+        with connect(address, timeout) as sock:
+            sock.sendall(json.dumps(payload).encode("utf-8") + b"\n")
+            line = read_line(sock, bytearray())
+    except socket.timeout:
+        raise RequestTimeout(
+            "no response from %s within %ss (op %r); safe to retry"
+            % (address, timeout, payload.get("op"))
+        )
+    if line is None:
+        raise ConnectionError(
+            "%s closed the connection without a response" % address
+        )
+    return json.loads(line)
+
+
+def stream(
+    address: str, payload: Dict, timeout: Optional[float] = None
+) -> Iterator[Dict]:
+    """Streaming request (the ``watch`` op): yield event dicts until
+    the terminating ``end`` event, an error response, or EOF.
+    ``timeout`` bounds the wait for EACH event."""
+    try:
+        with connect(address, timeout) as sock:
+            sock.sendall(json.dumps(payload).encode("utf-8") + b"\n")
+            buf = bytearray()
+            while True:
+                line = read_line(sock, buf)
+                if line is None:
+                    return
+                if not line.strip():
+                    continue
+                event = json.loads(line)
+                yield event
+                if not event.get("ok") or event.get("event") == "end":
+                    return
+    except socket.timeout:
+        raise RequestTimeout(
+            "no stream event from %s within %ss; safe to retry"
+            % (address, timeout)
+        )

@@ -426,11 +426,14 @@ def add_serve_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("--cache-entries", type=int, default=256, help="result-cache capacity (contracts)")
     group.add_argument("--no-warm", action="store_true", help="skip the blocking device-kernel warmup at startup")
     group.add_argument("--lanes", type=int, default=None, help="device lanes per shared round")
+    group.add_argument("--store", metavar="DIR", help="durable warm-store directory (docs/FLEET.md); results, solver memos and quarantine strikes persist there and are shared with other workers on the same directory")
 
 
 def run_serve(args) -> None:
     """The multi-tenant analysis service (docs/SERVICE.md): one process,
-    many submitted contracts, shared device rounds, cached results."""
+    many submitted contracts, shared device rounds, cached results.
+    With --store, the warm tier is durable and fleet-shared
+    (docs/FLEET.md)."""
     import mythril_tpu.laser.tpu.backend as backend
     from mythril_tpu.service import AnalysisService
     from mythril_tpu.service.api import SocketServer, serve_stdio
@@ -439,12 +442,23 @@ def run_serve(args) -> None:
         backend.DEFAULT_BATCH_CFG = backend.DEFAULT_BATCH_CFG._replace(
             lanes=args.lanes
         )
+    cache = None
+    if getattr(args, "store", None):
+        from mythril_tpu.fleet.store import DurableResultCache
+        from mythril_tpu.obs import catalog as _catalog
+
+        cache = DurableResultCache(
+            args.store, max_entries=args.cache_entries
+        )
+        _catalog.register_store(cache)
+        print("durable store at %s" % args.store, file=sys.stderr)
     service = AnalysisService(
         workers=args.workers,
         queue_size=args.queue_size,
         gather_window_s=args.gather_window,
         cache_entries=args.cache_entries,
         warm=not args.no_warm,
+        cache=cache,
     )
     try:
         if args.socket:
@@ -457,6 +471,8 @@ def run_serve(args) -> None:
         pass
     finally:
         service.shutdown(wait=False)
+        if cache is not None:
+            cache.close()
 
 
 def add_submit_flags(parser: argparse.ArgumentParser) -> None:
@@ -522,7 +538,9 @@ def run_submit(args) -> None:
 
 def add_top_flags(parser: argparse.ArgumentParser) -> None:
     group = parser.add_argument_group("monitoring")
-    group.add_argument("--socket", metavar="PATH", required=True, help="socket of a running `myth serve --socket`")
+    target = group.add_mutually_exclusive_group(required=True)
+    target.add_argument("--socket", metavar="PATH", help="socket of a running `myth serve --socket`")
+    target.add_argument("--gateway", metavar="HOST:PORT", help="address of a running `myth gateway` (fleet-wide view)")
     group.add_argument("--interval", type=float, default=0.0, metavar="SEC", help="refresh every SEC seconds (default: print once and exit)")
     group.add_argument("--count", type=int, default=0, metavar="N", help="with --interval: stop after N refreshes (default: until interrupted)")
 
@@ -591,23 +609,77 @@ def _render_top(stats: Dict, prom: Dict[str, float]) -> str:
     return "\n".join(lines)
 
 
-def run_top(args) -> None:
-    """Live service metrics console: one-shot by default, a refreshing
-    view with --interval (docs/OBSERVABILITY.md)."""
-    import time as _time
+def _render_fleet_top(fleet: Dict) -> str:
+    """One console frame for a whole fleet: gateway posture, admission
+    level, then one line per worker."""
+    gw = fleet.get("gateway", {})
+    adm = fleet.get("admission", {})
+    lines = [
+        "gateway   workers %d/%d alive   deaths %d   reroutes %d   jobs placed %d   up %.0fs"
+        % (
+            gw.get("workers_alive", 0), gw.get("workers", 0),
+            gw.get("worker_deaths", 0), gw.get("reroutes", 0),
+            gw.get("placements", 0), gw.get("uptime_s", 0.0),
+        ),
+        "admission level %.2f   queue pressure %.0f%%   warm rate %.0f%%   breaker %s   admitted %d   shed %d"
+        % (
+            adm.get("level", 0.0),
+            100.0 * adm.get("queue_pressure", 0.0),
+            100.0 * adm.get("warm_rate", 0.0),
+            "OPEN" if adm.get("breaker_open") else "closed",
+            adm.get("admitted", 0), adm.get("shed", 0),
+        ),
+    ]
+    for name in sorted(fleet.get("workers") or {}):
+        stats = (fleet["workers"] or {}).get(name)
+        if not stats:
+            lines.append("  %-10s DEAD" % name)
+            continue
+        cache = stats.get("cache", {})
+        total = cache.get("hits", 0) + cache.get("misses", 0)
+        lines.append(
+            "  %-10s queued %d   done %d   failed %d   warm %s   breaker %s"
+            % (
+                name, stats.get("queued", 0), stats.get("jobs_done", 0),
+                stats.get("jobs_failed", 0),
+                "%.0f%%" % (100.0 * cache.get("hits", 0) / total)
+                if total else "-",
+                stats.get("breaker_state", "?"),
+            )
+        )
+    return "\n".join(lines)
 
-    from mythril_tpu.service.api import request_over_socket
+
+def run_top(args) -> None:
+    """Live metrics console: one service (--socket) or a whole fleet
+    through its gateway (--gateway). One-shot by default, a refreshing
+    view with --interval (docs/OBSERVABILITY.md, docs/FLEET.md)."""
+    import time as _time
 
     shown = 0
     while True:
-        stats = request_over_socket(args.socket, {"op": "stats"}, timeout=10)
-        metrics = request_over_socket(args.socket, {"op": "metrics"}, timeout=10)
-        if not stats.get("ok") or not metrics.get("ok"):
-            raise CriticalError(
-                "service query failed: %s"
-                % (stats.get("error") or metrics.get("error"))
+        if args.gateway:
+            from mythril_tpu.fleet import transport
+
+            fleet = transport.request(
+                args.gateway, {"op": "fleet_stats"}, timeout=10
             )
-        frame = _render_top(stats, _parse_prometheus(metrics["metrics"]))
+            if not fleet.get("ok"):
+                raise CriticalError(
+                    "gateway query failed: %s" % fleet.get("error")
+                )
+            frame = _render_fleet_top(fleet)
+        else:
+            from mythril_tpu.service.api import request_over_socket
+
+            stats = request_over_socket(args.socket, {"op": "stats"}, timeout=10)
+            metrics = request_over_socket(args.socket, {"op": "metrics"}, timeout=10)
+            if not stats.get("ok") or not metrics.get("ok"):
+                raise CriticalError(
+                    "service query failed: %s"
+                    % (stats.get("error") or metrics.get("error"))
+                )
+            frame = _render_top(stats, _parse_prometheus(metrics["metrics"]))
         if args.interval and shown:
             print()
         print(frame)
@@ -615,6 +687,118 @@ def run_top(args) -> None:
         if not args.interval or (args.count and shown >= args.count):
             return
         _time.sleep(args.interval)
+
+
+def add_gateway_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("fleet gateway")
+    group.add_argument("--listen", metavar="HOST:PORT", default="127.0.0.1:8551", help="TCP address to serve on (line-JSON protocol with HTTP sniffing)")
+    group.add_argument("--worker", metavar="NAME=ADDR", action="append", default=[], help="attach an existing worker (repeatable); ADDR is a socket path or host:port")
+    group.add_argument("--spawn", type=int, default=0, metavar="N", help="additionally spawn N local worker processes (`myth serve`)")
+    group.add_argument("--store", metavar="DIR", help="shared durable store directory for spawned workers")
+    group.add_argument("--spawn-queue-size", type=int, default=16, help="job queue size for spawned workers")
+    group.add_argument("--warm", action="store_true", help="spawned workers run the device warmup at startup")
+    group.add_argument("--rate", type=float, default=8.0, metavar="PER_SEC", help="base per-tenant admission rate")
+    group.add_argument("--burst", type=float, default=16.0, help="per-tenant admission burst")
+
+
+def run_gateway(args) -> None:
+    """The fleet front gateway (docs/FLEET.md): routes submissions over
+    a consistent-hash ring of workers, re-routes jobs off dead workers,
+    streams watch events, and sheds load per tenant."""
+    import atexit
+    import tempfile
+
+    from mythril_tpu.fleet.gateway import Gateway, GatewayServer
+    from mythril_tpu.fleet.qos import AdmissionController
+    from mythril_tpu.fleet.worker import (
+        SocketWorker, spawn_worker, wait_for_socket,
+    )
+
+    workers = []
+    for spec in args.worker:
+        name, sep, addr = spec.partition("=")
+        if not sep:
+            raise CriticalError(
+                "--worker wants NAME=ADDR, got %r" % spec
+            )
+        workers.append(SocketWorker(name, addr))
+    procs = []
+    if args.spawn:
+        run_dir = tempfile.mkdtemp(prefix="myth-fleet-")
+        for i in range(args.spawn):
+            sock = os.path.join(run_dir, "worker%d.sock" % i)
+            procs.append(spawn_worker(
+                sock, store_dir=args.store,
+                queue_size=args.spawn_queue_size, warm=args.warm,
+            ))
+            workers.append(SocketWorker("worker%d" % i, sock))
+        for proc, worker in zip(procs, workers[-args.spawn:]):
+            print("waiting for %s ..." % worker.address, file=sys.stderr)
+            wait_for_socket(worker.address, process=proc)
+
+        def _reap():
+            for proc in procs:
+                proc.terminate()
+        atexit.register(_reap)
+    if not workers:
+        raise CriticalError(
+            "no workers: pass --worker NAME=ADDR and/or --spawn N"
+        )
+    host, _, port = args.listen.rpartition(":")
+    gateway = Gateway(
+        workers,
+        admission=AdmissionController(
+            base_rate_per_s=args.rate, burst=args.burst
+        ),
+    )
+    gateway.start()
+    server = GatewayServer(gateway, host or "127.0.0.1", int(port))
+    print(
+        "gateway on %s (%d workers)" % (server.address, len(workers)),
+        file=sys.stderr,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gateway.stop()
+
+
+def add_scan_flags(parser: argparse.ArgumentParser) -> None:
+    group = parser.add_argument_group("chain scan")
+    group.add_argument("--gateway", metavar="HOST:PORT", required=True, help="address of a running `myth gateway`")
+    group.add_argument("-n", "--contracts", type=int, default=20, help="number of deployments to scan")
+    group.add_argument("--seed", type=int, default=1337, help="RNG seed (corpus choice, dup choice, metadata bytes)")
+    group.add_argument("--dup-rate", type=float, default=0.4, help="probability a deployment is an exact re-submission")
+    group.add_argument("--rate", type=float, default=0.0, metavar="PER_SEC", help="client-side submission rate limit (0 = unthrottled)")
+    group.add_argument("--watch-fraction", type=float, default=0.25, help="fraction of submissions that also open a watch stream")
+    group.add_argument("--tenant", default="chain-scan", help="tenant name for QoS accounting")
+    group.add_argument("-t", "--transaction-count", type=int, default=2, help="transaction depth per contract")
+    group.add_argument("--execution-timeout", type=int, default=60, metavar="SEC", help="per-job symbolic execution budget")
+
+
+def run_scan(args) -> None:
+    """Chain-scan ingest (docs/FLEET.md): stream a synthetic block-
+    explorer workload (near-duplicate deployments) at a fleet gateway
+    and report throughput, latency, and warm-tier absorption."""
+    from mythril_tpu.fleet.ingest import ChainScan
+    from mythril_tpu.fleet.worker import SocketWorker
+
+    scan = ChainScan(
+        SocketWorker("gateway", args.gateway),
+        seed=args.seed,
+        dup_rate=args.dup_rate,
+        rate_per_s=args.rate,
+        watch_fraction=args.watch_fraction,
+        tenant=args.tenant,
+        tx_count=args.transaction_count,
+        timeout=args.execution_timeout,
+    )
+    summary = scan.run(args.contracts)
+    print(json.dumps(summary, indent=2))
+    if summary["completed"] == 0:
+        raise CriticalError("chain scan completed 0 contracts")
 
 
 # ------------------------------------------------------------------ registry
@@ -645,6 +829,16 @@ COMMANDS: Dict[str, Tuple[str, List[Callable], Callable]] = {
         "Shows live metrics from a running analysis service",
         [add_top_flags],
         run_top,
+    ),
+    "gateway": (
+        "Runs the fleet front gateway over analysis workers",
+        [add_gateway_flags],
+        run_gateway,
+    ),
+    "scan": (
+        "Streams a chain-scan ingest workload at a fleet gateway",
+        [add_scan_flags],
+        run_scan,
     ),
     "pro": (
         "Analyzes input with the MythX API (https://mythx.io)",

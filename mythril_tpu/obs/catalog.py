@@ -128,6 +128,70 @@ TRACE_DROPPED_TOTAL = REGISTRY.counter(
     "myth_trace_dropped_total", "trace events dropped by the ring buffer"
 )
 
+# -- fleet gateway (fleet/gateway.py) ----------------------------------
+
+# The gateway is device-free and must stay that way at RUNTIME too:
+# rendering the shared REGISTRY pulls the solver collector, whose
+# sampler imports the laser stack. The gateway therefore owns a
+# SEPARATE registry — its instruments still live here (metric_names
+# lint rule), and its `metrics` op serves this registry's exposition
+# alongside the per-worker texts it aggregates.
+GATEWAY_REGISTRY = _m.MetricsRegistry()
+
+GATEWAY_REQUESTS_TOTAL = GATEWAY_REGISTRY.counter(
+    "myth_gateway_requests_total",
+    "requests handled by the fleet gateway",
+    labelnames=("op",),
+)
+GATEWAY_SHED_TOTAL = GATEWAY_REGISTRY.counter(
+    "myth_gateway_shed_total", "submissions shed by QoS admission"
+)
+GATEWAY_WORKER_DEATHS_TOTAL = GATEWAY_REGISTRY.counter(
+    "myth_gateway_worker_deaths_total", "worker-death detections"
+)
+GATEWAY_REROUTES_TOTAL = GATEWAY_REGISTRY.counter(
+    "myth_gateway_reroutes_total",
+    "jobs re-routed to a surviving worker after a death",
+)
+GATEWAY_STREAM_EVENTS_TOTAL = GATEWAY_REGISTRY.counter(
+    "myth_gateway_stream_events_total",
+    "watch stream events forwarded to clients",
+)
+GATEWAY_WORKERS_ALIVE = GATEWAY_REGISTRY.gauge(
+    "myth_gateway_workers_alive_total", "workers currently routable"
+)
+
+# -- durable store (fleet/store.py), sampled in the WORKER process -----
+
+
+def make_store_collector(cache):
+    """Sample fn for one DurableResultCache; registered under the keyed
+    slot ``"fleet_store"`` so a worker restart replaces, not doubles."""
+
+    def _store_samples():
+        st = cache.stats()
+        store = st["store"]
+        return [
+            ("myth_store_records_total", (), store["records"]),
+            ("myth_store_appends_total", (), store["appends"]),
+            ("myth_store_replayed_total", (), store["replayed"]),
+            ("myth_store_refreshes_total", (), store["refreshes"]),
+            ("myth_store_checkpoints_total", (), store["checkpoints"]),
+            ("myth_store_torn_records_total", (), store["torn_records"]),
+            ("myth_store_disk_bytes", (), store["disk_bytes"]),
+            (
+                "myth_store_cross_process_hits_total",
+                (),
+                st["cross_process_hits"],
+            ),
+        ]
+
+    return _store_samples
+
+
+def register_store(cache) -> None:
+    REGISTRY.register_collector("fleet_store", make_store_collector(cache))
+
 
 # -- pull collectors for the pre-existing stats surfaces ---------------
 
@@ -234,6 +298,21 @@ def make_service_collector(service):
             ("myth_result_cache_hits_total", (), cache["hits"]),
             ("myth_result_cache_misses_total", (), cache["misses"]),
             ("myth_quarantined_jobs_total", (), st["quarantined_jobs"]),
+            (
+                "myth_solver_memo_entries_total",
+                (),
+                cache["solver_memo_entries"],
+            ),
+            (
+                "myth_solver_memo_evictions_total",
+                (("kind", "entry"),),
+                cache["solver_memo_evictions"],
+            ),
+            (
+                "myth_solver_memo_evictions_total",
+                (("kind", "verdict"),),
+                cache["solver_verdict_evictions"],
+            ),
         ]
 
     return _service_samples

@@ -12,7 +12,19 @@ One request protocol serves both transports:
 Request shape: ``{"op": <name>, ...params}``. Responses always carry
 ``{"ok": true/false, ...}``; a false ``ok`` carries ``"error"`` (and
 ``"kind"`` distinguishing admission rejects from backpressure so
-clients know whether to retry). See docs/SERVICE.md for the op table.
+clients know whether to retry). The ``watch`` op is the one streaming
+exception: it answers with a SEQUENCE of event lines (``issue`` events
+as detection modules fire) terminated by exactly one ``end`` event.
+See docs/SERVICE.md for the op table; the fleet gateway
+(mythril_tpu/fleet/gateway.py) speaks this same protocol to its
+workers and re-exports it over TCP/HTTP.
+
+Robustness: request lines are bounded (``MAX_REQUEST_BYTES``) — an
+oversized or garbage line gets a structured ``bad-request`` response
+and the connection keeps serving instead of buffering without limit or
+dying. Client-side timeouts raise :class:`RequestTimeout`, whose
+``retryable`` flag tells callers the request may simply be resent
+(nothing was necessarily lost — the service may still be working).
 """
 
 import json
@@ -20,8 +32,9 @@ import logging
 import os
 import socket
 import threading
-from typing import Dict, Optional
+from typing import Dict, Iterator, Optional
 
+from mythril_tpu.service.cache import cache_key
 from mythril_tpu.service.scheduler import (
     AdmissionError,
     AnalysisService,
@@ -30,9 +43,35 @@ from mythril_tpu.service.scheduler import (
 
 log = logging.getLogger(__name__)
 
+# hard ceiling on one request line. Far above any legitimate submission
+# (code is capped at scheduler.MAX_CODE_BYTES = 1 MiB of bytes = 2 MiB
+# of hex) but low enough that a garbage client cannot balloon the
+# server's receive buffer.
+MAX_REQUEST_BYTES = 4 << 20
+
+
+class RequestTimeout(TimeoutError):
+    """A client-side request deadline expired. ``retryable`` is True:
+    the service may still be healthy (a long `result` wait, a stalled
+    peer) and the request can be resent as-is."""
+
+    retryable = True
+
+
+def _oversized_response() -> Dict:
+    return {
+        "ok": False,
+        "kind": "bad-request",
+        "error": "request line exceeds %d bytes" % MAX_REQUEST_BYTES,
+        "retryable": False,
+    }
+
 
 def handle_request(service: AnalysisService, request: Dict) -> Dict:
-    """Dispatch one decoded request against the service; never raises."""
+    """Dispatch one decoded request against the service; never raises.
+
+    The streaming ``watch`` op does not fit the one-dict shape and is
+    handled by the transports via :func:`stream_watch`."""
     try:
         op = request.get("op")
         if op == "ping":
@@ -87,35 +126,112 @@ def handle_request(service: AnalysisService, request: Dict) -> Dict:
                 "quarantined_jobs": stats["quarantined_jobs"],
                 "checkpoint_overhead_s": stats["checkpoint_overhead_s"],
             }
+        if op == "probe":
+            # warm-state introspection for one code hash, WITHOUT
+            # running anything: does the durable/in-memory warm tier
+            # know this contract? Operators and the fleet bench use it
+            # to verify memos and quarantine survive worker restarts.
+            key = cache_key(
+                request.get("creation_code", ""), request.get("code", "")
+            )
+            memo = service.cache.get_solver_memo(key)
+            return {
+                "ok": True,
+                "key": key.hex(),
+                "memo_verdicts": len(memo or {}),
+                "quarantined": service.cache.is_quarantined(key),
+                "quarantine_reason": service.cache.quarantine_reason(key),
+            }
+        if op == "quarantine":
+            # operator override: mark a code hash poisonous up front
+            # (e.g. a known analysis-crasher reported from another
+            # deployment) without burning two crash strikes on it
+            key = cache_key(
+                request.get("creation_code", ""), request.get("code", "")
+            )
+            reason = str(request.get("reason", "operator quarantine"))
+            service.cache.force_quarantine(key, reason)
+            return {"ok": True, "key": key.hex(), "quarantined": True}
+        if op == "lift-quarantine":
+            key = cache_key(
+                request.get("creation_code", ""), request.get("code", "")
+            )
+            return {
+                "ok": True,
+                "key": key.hex(),
+                "lifted": service.cache.lift_quarantine(key),
+            }
         if op == "shutdown":
             return {"ok": True, "shutdown": True}
         return {"ok": False, "kind": "bad-request", "error": "unknown op %r" % op}
     except QueueFullError as e:
-        return {"ok": False, "kind": "backpressure", "error": str(e)}
+        return {"ok": False, "kind": "backpressure", "error": str(e),
+                "retryable": True}
     except AdmissionError as e:
-        return {"ok": False, "kind": "admission", "error": str(e)}
+        return {"ok": False, "kind": "admission", "error": str(e),
+                "retryable": False}
     except (KeyError, TypeError, ValueError) as e:
-        return {"ok": False, "kind": "bad-request", "error": str(e)}
+        return {"ok": False, "kind": "bad-request", "error": str(e),
+                "retryable": False}
     except Exception as e:  # pragma: no cover - defensive
         log.exception("request failed")
         return {"ok": False, "kind": "internal", "error": str(e)}
 
 
+def stream_watch(service: AnalysisService, request: Dict) -> Iterator[Dict]:
+    """The streaming op: yield the job's issue events as they fire,
+    then one ``end`` event. A bad job id yields a single error dict."""
+    try:
+        job_id = int(request["job_id"])
+        service.status(job_id)  # raises KeyError for unknown ids
+    except (KeyError, TypeError, ValueError) as e:
+        yield {"ok": False, "kind": "bad-request", "error": str(e),
+               "retryable": False}
+        return
+    for event in service.watch(job_id):
+        yield {"ok": True, **event}
+
+
+def _dispatch_line(service: AnalysisService, line: str, write) -> Dict:
+    """Decode one request line and write its response line(s) via
+    ``write``; returns the LAST response written (transports key their
+    shutdown handling off it)."""
+    try:
+        request = json.loads(line)
+        if not isinstance(request, dict):
+            raise ValueError("request must be a JSON object")
+    except (json.JSONDecodeError, ValueError) as e:
+        response = {"ok": False, "kind": "bad-request", "error": str(e),
+                    "retryable": False}
+        write(response)
+        return response
+    if request.get("op") == "watch":
+        response: Dict = {}
+        for response in stream_watch(service, request):
+            write(response)
+        return response
+    response = handle_request(service, request)
+    write(response)
+    return response
+
+
 def serve_stdio(service: AnalysisService, infile, outfile) -> None:
     """One JSON request per input line, one JSON response per output
-    line. Returns after EOF or an explicit shutdown op."""
+    line (the ``watch`` op writes its event sequence). Returns after
+    EOF or an explicit shutdown op."""
+
+    def write(response: Dict) -> None:
+        outfile.write(json.dumps(response) + "\n")
+        outfile.flush()
+
     for line in infile:
+        if len(line) > MAX_REQUEST_BYTES:
+            write(_oversized_response())
+            continue
         line = line.strip()
         if not line:
             continue
-        try:
-            request = json.loads(line)
-        except json.JSONDecodeError as e:
-            response = {"ok": False, "kind": "bad-request", "error": str(e)}
-        else:
-            response = handle_request(service, request)
-        outfile.write(json.dumps(response) + "\n")
-        outfile.flush()
+        response = _dispatch_line(service, line, write)
         if response.get("shutdown"):
             return
 
@@ -153,36 +269,103 @@ class SocketServer:
         self._stop.set()
 
     def _serve_connection(self, conn: socket.socket) -> None:
-        with conn, conn.makefile("rw", encoding="utf-8") as stream:
-            for line in stream:
-                line = line.strip()
-                if not line:
-                    continue
+        """Bounded line reader: a request line larger than
+        ``MAX_REQUEST_BYTES`` gets a structured ``bad-request`` response
+        and the rest of that line is discarded — the connection keeps
+        serving (regression: ``conn.makefile`` + ``for line in stream``
+        buffered without limit and a garbage client could balloon the
+        server)."""
+        with conn:
+            wfile = conn.makefile("w", encoding="utf-8")
+
+            def write(response: Dict) -> None:
+                wfile.write(json.dumps(response) + "\n")
+                wfile.flush()
+
+            buf = b""
+            discarding = False
+            while True:
                 try:
-                    request = json.loads(line)
-                except json.JSONDecodeError as e:
-                    response = {"ok": False, "kind": "bad-request", "error": str(e)}
-                else:
-                    response = handle_request(self.service, request)
-                stream.write(json.dumps(response) + "\n")
-                stream.flush()
-                if response.get("shutdown"):
-                    self.stop()
+                    chunk = conn.recv(65536)
+                except OSError:
                     return
+                if not chunk:
+                    return
+                buf += chunk
+                while True:
+                    idx = buf.find(b"\n")
+                    if idx < 0:
+                        if len(buf) > MAX_REQUEST_BYTES:
+                            if not discarding:
+                                write(_oversized_response())
+                                discarding = True
+                            buf = b""
+                        break
+                    raw, buf = buf[:idx], buf[idx + 1:]
+                    if discarding:
+                        # tail of an oversized line already answered
+                        discarding = False
+                        continue
+                    if len(raw) > MAX_REQUEST_BYTES:
+                        write(_oversized_response())
+                        continue
+                    line = raw.decode("utf-8", errors="replace").strip()
+                    if not line:
+                        continue
+                    response = _dispatch_line(self.service, line, write)
+                    if response.get("shutdown"):
+                        self.stop()
+                        return
 
 
 def request_over_socket(
     path: str, request: Dict, timeout: Optional[float] = None
 ) -> Dict:
     """Client half: send one request to a serving socket, return the
-    decoded response (``myth submit`` uses this)."""
-    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
-        sock.settimeout(timeout)
-        sock.connect(path)
-        with sock.makefile("rw", encoding="utf-8") as stream:
-            stream.write(json.dumps(request) + "\n")
-            stream.flush()
-            line = stream.readline()
+    decoded response (``myth submit`` uses this). Raises
+    :class:`RequestTimeout` (``retryable=True``) when the deadline
+    expires before a response line arrives."""
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+            sock.settimeout(timeout)
+            sock.connect(path)
+            with sock.makefile("rw", encoding="utf-8") as stream:
+                stream.write(json.dumps(request) + "\n")
+                stream.flush()
+                line = stream.readline()
+    except socket.timeout:
+        raise RequestTimeout(
+            "no response from %s within %ss (request %r); safe to retry"
+            % (path, timeout, request.get("op"))
+        )
     if not line:
         raise ConnectionError("service closed the connection without a response")
     return json.loads(line)
+
+
+def stream_over_socket(
+    path: str, request: Dict, timeout: Optional[float] = None
+) -> Iterator[Dict]:
+    """Client half of the ``watch`` op: yield decoded event lines until
+    the terminating ``end`` event (or an error response). ``timeout``
+    bounds the wait for EACH event, not the whole stream."""
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+            sock.settimeout(timeout)
+            sock.connect(path)
+            with sock.makefile("rw", encoding="utf-8") as stream:
+                stream.write(json.dumps(request) + "\n")
+                stream.flush()
+                for line in stream:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    yield event
+                    if not event.get("ok") or event.get("event") == "end":
+                        return
+    except socket.timeout:
+        raise RequestTimeout(
+            "no stream event from %s within %ss; safe to retry"
+            % (path, timeout)
+        )

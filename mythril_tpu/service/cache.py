@@ -93,8 +93,17 @@ class ResultCache:
         # not depend on budgets or module whitelists, so a resubmission
         # with different parameters still starts with warm verdicts.
         # NOT schema-independent, though — see _memo_key.
-        self._solver_memos: "OrderedDict[Tuple, Dict[bytes, int]]" = OrderedDict()
+        self._solver_memos: "OrderedDict[Tuple, OrderedDict[bytes, int]]" = (
+            OrderedDict()
+        )
         self.solver_memo_max = 128
+        # per-hash verdict cap: a long-lived service re-running one hot
+        # contract under many parameter sets would otherwise accrete
+        # digests without limit (every put merges, nothing ever left).
+        # LRU within the entry: the digests merged longest ago go first.
+        self.solver_memo_verdicts_max = 4096
+        self.solver_memo_evictions = 0  # whole per-hash entries dropped
+        self.solver_verdict_evictions = 0  # individual digests dropped
         self.hits = 0
         self.misses = 0
         # poison-job quarantine: code hash -> crash strike count, and
@@ -179,19 +188,28 @@ class ResultCache:
     def put_solver_memo(self, key: bytes, memo: Dict[bytes, int]) -> None:
         """Merge a finished job's exported verdicts into the code hash's
         memo (merge, not replace: later jobs under other parameters may
-        have explored different regions)."""
+        have explored different regions). Growth is bounded both ways:
+        at most ``solver_memo_max`` hashes, each holding at most
+        ``solver_memo_verdicts_max`` digests (LRU within the entry);
+        evictions are counted and exposed in :meth:`stats`."""
         if not memo:
             return
         mkey = self._memo_key(key)
         with self._lock:
             entry = self._solver_memos.get(mkey)
             if entry is None:
-                entry = {}
+                entry = OrderedDict()
                 self._solver_memos[mkey] = entry
-            entry.update(memo)
+            for digest, verdict in memo.items():
+                entry[digest] = verdict
+                entry.move_to_end(digest)
+            while len(entry) > self.solver_memo_verdicts_max:
+                entry.popitem(last=False)
+                self.solver_verdict_evictions += 1
             self._solver_memos.move_to_end(mkey)
             while len(self._solver_memos) > self.solver_memo_max:
                 self._solver_memos.popitem(last=False)
+                self.solver_memo_evictions += 1
 
     # -- poison-job quarantine ------------------------------------------
 
@@ -239,6 +257,13 @@ class ResultCache:
             self._crash_reports.pop(key, None)
             return self._quarantined.pop(key, None) is not None
 
+    def force_quarantine(self, key: bytes, reason: str) -> None:
+        """Operator override in the other direction: quarantine a hash
+        up front (api `quarantine` op) without burning crash strikes —
+        e.g. a known analysis-crasher reported by another deployment."""
+        with self._lock:
+            self._quarantined[key] = reason
+
     @staticmethod
     def _reseed_static_pass(tables) -> None:
         """Re-insert the held static-pass tables into the pass's own LRU
@@ -256,6 +281,12 @@ class ResultCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "quarantined": len(self._quarantined),
+                "solver_memo_entries": len(self._solver_memos),
+                "solver_memo_verdicts": sum(
+                    len(m) for m in self._solver_memos.values()
+                ),
+                "solver_memo_evictions": self.solver_memo_evictions,
+                "solver_verdict_evictions": self.solver_verdict_evictions,
             }
 
     def __len__(self) -> int:

@@ -54,6 +54,7 @@ from mythril_tpu.service.lanes import (
     JobContext,
     LaneCoordinator,
 )
+from mythril_tpu.support import events
 
 log = logging.getLogger(__name__)
 
@@ -63,6 +64,9 @@ JOB_ADDRESS = 0x1234
 # hard ceiling on submitted code (creation + runtime): far above EIP-170
 # but low enough that a malformed submission cannot balloon the packer
 MAX_CODE_BYTES = 1 << 20
+
+# shared by every AnalysisService in the process — see _ids in __init__
+_JOB_IDS = itertools.count(1)
 
 
 class AdmissionError(ValueError):
@@ -127,6 +131,17 @@ class AnalysisJob:
         self.cancel_event = threading.Event()
         self.done_event = threading.Event()
         self._finish_lock = threading.Lock()
+        # streamed partial results (`watch` op): issue events appended
+        # live by the service's bus listener as detection modules fire.
+        # Append-only; watchers iterate by index (never mutated in
+        # place), so readers need no lock — _stream_cv just wakes them.
+        self.stream_events: List[Dict] = []
+        self._stream_cv = threading.Condition(threading.Lock())
+
+    def push_stream_event(self, event: Dict) -> None:
+        with self._stream_cv:
+            self.stream_events.append(event)
+            self._stream_cv.notify_all()
 
     @property
     def internal_name(self) -> str:
@@ -189,6 +204,7 @@ class AnalysisService:
         gather_window_s: float = DEFAULT_GATHER_WINDOW_S,
         cache_entries: int = 256,
         warm: bool = False,
+        cache: Optional[ResultCache] = None,
     ):
         if batch_cfg is None:
             from mythril_tpu.laser.tpu import backend
@@ -202,7 +218,13 @@ class AnalysisService:
         self.coordinator = LaneCoordinator(
             batch_cfg, self.host_lock, gather_window_s=gather_window_s
         )
-        self.cache = ResultCache(max_entries=cache_entries)
+        # injectable cache backend: the fleet tier passes a
+        # fleet/store.DurableResultCache so results, solver memos and
+        # quarantine strikes survive restarts and are shared
+        # cross-process; default stays the in-memory LRU
+        self.cache = cache if cache is not None else ResultCache(
+            max_entries=cache_entries
+        )
         # frontier checkpoints (keyed by job id): a FAILED job's one
         # retry resumes from its latest journaled frontier
         self.journal = CheckpointJournal()
@@ -210,7 +232,12 @@ class AnalysisService:
         self._queue: "deque[AnalysisJob]" = deque()
         self._queue_cv = threading.Condition(threading.Lock())
         self._jobs: Dict[int, AnalysisJob] = {}
-        self._ids = itertools.count(1)  # 0 marks a free lane (batch.py)
+        # PROCESS-global, not per-service: job ids feed internal_name,
+        # which the issue-bus listener uses to attribute stream events —
+        # two service instances in one process (fleet in-proc tests)
+        # must never mint colliding "<name>#<id>" identities. 0 marks a
+        # free lane (batch.py).
+        self._ids = _JOB_IDS
         self._shutdown = False
         # service counters: every mutation goes through _count() (or
         # happens while already holding _queue_cv's lock) so concurrent
@@ -224,6 +251,11 @@ class AnalysisService:
         # Prometheus exposition: this instance's samples replace any
         # prior service's in the shared registry (keyed slot)
         _obs_catalog.register_service(self)
+        # streaming partial results: detection modules publish every
+        # finding on the process-wide issue bus the moment it exists;
+        # the listener maps it back to the owning job via the unique
+        # internal contract name and appends a `watch` stream event
+        self._issue_listener = events.ISSUE_BUS.subscribe(self._on_issue)
         self._workers = [
             threading.Thread(
                 target=self._worker, name="analysis-worker-%d" % i, daemon=True
@@ -292,6 +324,22 @@ class AnalysisService:
                 "cache_hit": True,
                 "cold_wall_s": entry.cold_wall_s,
             }
+            # a watcher of a warm job still gets the full issue stream
+            # (source-tagged): the cached findings never re-fire on the
+            # bus, so replay them as stream events here
+            now = time.time()
+            for issue_dict in entry.issues:
+                issue_dict = dict(issue_dict)
+                issue_dict["contract"] = job.name
+                job.push_stream_event(
+                    {
+                        "event": "issue",
+                        "job_id": job.id,
+                        "issue": issue_dict,
+                        "source": "cache",
+                        "t": now,
+                    }
+                )
             job.finish(JobState.DONE)
             self._count("jobs_done")
             return job.id
@@ -320,6 +368,73 @@ class AnalysisService:
     def wait(self, job_id: int, timeout: Optional[float] = None) -> bool:
         return self._job(job_id).done_event.wait(timeout)
 
+    # ---------------------------------------------- streaming (`watch` op)
+
+    def _on_issue(self, contract_name: str, issue) -> None:
+        """Issue-bus listener: attribute a freshly fired finding to the
+        owning job (unique internal name ``<name>#<id>``) and append a
+        stream event. Findings from other services' jobs — or from the
+        plain CLI path, which never runs under an internal name — fall
+        through silently."""
+        _, sep, id_part = str(contract_name).rpartition("#")
+        if not sep or not id_part.isdigit():
+            return
+        job = self._jobs.get(int(id_part))
+        if job is None or job.internal_name != contract_name:
+            return
+        try:
+            issue_dict = dict(issue.as_dict)
+        except Exception as e:  # pragma: no cover - defensive
+            issue_dict = {"title": str(issue), "render_error": str(e)}
+        # the watcher asked about <name>, not the internal tenancy name
+        issue_dict["contract"] = job.name
+        job.push_stream_event(
+            {
+                "event": "issue",
+                "job_id": job.id,
+                "issue": issue_dict,
+                "t": time.time(),
+            }
+        )
+
+    def watch(self, job_id: int, poll_s: float = 0.1):
+        """Generator of stream events for one job: every ``issue`` event
+        as detection modules fire (replayed from the start for a late
+        subscriber), terminated by exactly one ``end`` event carrying
+        the final state. Safe to call on an already-finished job — the
+        full history replays, then ``end``."""
+        job = self._job(job_id)
+        idx = 0
+        while True:
+            events_now = job.stream_events
+            while idx < len(events_now):
+                yield events_now[idx]
+                idx += 1
+            if job.done_event.is_set():
+                # drain anything that raced in between the len() read
+                # and the done check, then finish
+                events_now = job.stream_events
+                while idx < len(events_now):
+                    yield events_now[idx]
+                    idx += 1
+                break
+            with job._stream_cv:
+                if len(job.stream_events) == idx and not job.done_event.is_set():
+                    job._stream_cv.wait(poll_s)
+        status = job.status_dict()
+        result = job.result or {}
+        yield {
+            "event": "end",
+            "job_id": job.id,
+            "state": status["state"],
+            "cache_hit": status["cache_hit"],
+            "wall_s": status["wall_s"],
+            "error": status["error"],
+            "issues": len(result.get("issues", [])),
+            "swc_ids": result.get("swc_ids", []),
+            "t": time.time(),
+        }
+
     def cancel(self, job_id: int) -> bool:
         """Request cancellation; returns True if the job had not already
         finished. Queued jobs complete as CANCELLED without running;
@@ -345,6 +460,9 @@ class AnalysisService:
                 "jobs_cancelled": self.jobs_cancelled,
                 "jobs_retried": self.jobs_retried,
                 "queued": len(self._queue),
+                # capacity rides along so fleet admission control can
+                # compute queue pressure without configuration coupling
+                "queue_size": self.queue_size,
             }
         return {
             **counters,
@@ -369,6 +487,7 @@ class AnalysisService:
         (its worker's own later finalize is a no-op: finish() is
         idempotent and returns False to the loser)."""
         self._shutdown = True
+        events.ISSUE_BUS.unsubscribe(self._issue_listener)
         with self._queue_cv:
             drained = list(self._queue)
             self._queue.clear()

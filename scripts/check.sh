@@ -96,6 +96,18 @@ python -m pytest tests/robustness/ \
     -q -p no:cacheprovider \
     -k "not matrix and not slow"
 
+echo "== fleet fast tests =="
+# fleet tier, no devices by construction (the fleet_boundary lint rule
+# keeps jax out of gateway/store): hash-ring routing, durable-store
+# crash recovery, QoS tuning, gateway death/re-route, and the 2-worker
+# in-proc smoke — dup bytecode warm-hits across workers through the
+# shared store and a watch stream delivers an issue event before the
+# job completes. Subprocess fleet integration (real `myth serve`
+# workers) runs with bench.py --fleet, not here.
+python -m pytest tests/fleet/ \
+    -q -p no:cacheprovider \
+    -k "not subprocess and not slow"
+
 echo "== megakernel smoke =="
 # fused device-loop smoke: one tiny-lane compile of the megakernel plus
 # the compaction/prune unit checks (CPU jit, seconds). The fused-vs-

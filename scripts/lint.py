@@ -646,6 +646,56 @@ def device_loop_purity(tree: ast.AST, source: str, rel: str):
     return sorted(set(out))
 
 
+# The fleet tier must start on machines with NO accelerator: the
+# gateway, the durable store, and their plumbing may never import jax
+# or the laser (device) layer, directly or lazily — one stray import
+# would pull kernel compilation into the routing path and pin the
+# gateway to a device image (docs/FLEET.md). The service/obs/support
+# layers are fine (verified jax-free at import time).
+_FLEET_DEVICE_FREE = {
+    "mythril_tpu/fleet/__init__.py",
+    "mythril_tpu/fleet/gateway.py",
+    "mythril_tpu/fleet/store.py",
+    "mythril_tpu/fleet/hashring.py",
+    "mythril_tpu/fleet/transport.py",
+    "mythril_tpu/fleet/qos.py",
+    "mythril_tpu/fleet/ingest.py",
+    "mythril_tpu/fleet/worker.py",
+}
+
+_DEVICE_MODULE_PREFIXES = ("jax", "jaxlib", "mythril_tpu.laser")
+
+
+def fleet_boundary(tree: ast.AST, source: str, rel: str):
+    """(lineno, desc) pairs for device-layer imports (jax*,
+    mythril_tpu.laser*) anywhere in the device-free fleet modules —
+    including imports inside function bodies, which would fire lazily
+    in production. noqa exempts (none expected)."""
+    if rel not in _FLEET_DEVICE_FREE:
+        return []
+    lines = source.splitlines()
+    out = []
+
+    def _flag(lineno: int, module: str) -> None:
+        if not _noqa(lines, lineno):
+            out.append((
+                lineno,
+                f"fleet_boundary: device-layer import '{module}' in a "
+                "device-free fleet module (the gateway/store tier must "
+                "run without jax)",
+            ))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith(_DEVICE_MODULE_PREFIXES):
+                    _flag(node.lineno, alias.name)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.startswith(_DEVICE_MODULE_PREFIXES):
+                _flag(node.lineno, node.module)
+    return sorted(set(out))
+
+
 def _swc_registry():
     """(constant name -> id string, set of valid SWC id strings) from
     analysis/swc_data.py (module-level string assignments + the
@@ -898,6 +948,8 @@ def main() -> int:
         for lineno, desc in metric_names(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for lineno, desc in device_loop_purity(tree, source, str(rel)):
+            problems.append(f"{rel}:{lineno}: {desc}")
+        for lineno, desc in fleet_boundary(tree, source, str(rel)):
             problems.append(f"{rel}:{lineno}: {desc}")
         for i, line in enumerate(source.splitlines(), 1):
             stripped = line.rstrip("\n")

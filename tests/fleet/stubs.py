@@ -1,0 +1,64 @@
+"""Shared fleet-test stand-ins.
+
+Invariant I2 (docs/SERVICE.md) forbids two REAL pipelines in one
+process, so in-proc fleet tests run :class:`FleetStubService`: the
+real scheduler, cache plumbing, and streaming seam, with the symbolic
+execution replaced by a stub that fires one issue through the actual
+issue bus and writes the real cache records — exactly the surfaces
+the fleet tier integrates against.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+from mythril_tpu.service import AnalysisService, JobState
+from mythril_tpu.support import events
+
+DUMMY_CFG = SimpleNamespace(lanes=8)
+
+
+class StubIssue:
+    """Duck-typed Issue: the bus listener only reads .as_dict."""
+
+    def __init__(self, contract: str, title: str, swc_id: str):
+        self.contract = contract
+        self.as_dict = {
+            "title": title,
+            "swc-id": swc_id,
+            "contract": contract,
+        }
+
+
+class FleetStubService(AnalysisService):
+    """Pipeline stub that exercises the real streaming + cache path:
+    publish one issue on the bus (mid-run, so watchers see it while the
+    job is RUNNING), block on ``release``, then finish and persist the
+    report + a solver memo like the real finalizer does."""
+
+    def __init__(self, issue_title="Stubbed finding", swc_id="101", **kw):
+        self.release = threading.Event()
+        self.release.set()
+        self.issue_title = issue_title
+        self.swc_id = swc_id
+        super().__init__(batch_cfg=DUMMY_CFG, **kw)
+
+    def _run_job(self, job):
+        job.state = JobState.RUNNING
+        job.started_at = time.time()
+        issue = StubIssue(job.internal_name, self.issue_title, self.swc_id)
+        events.ISSUE_BUS.publish(job.internal_name, issue)
+        self.release.wait(timeout=30)
+        issues = [dict(issue.as_dict, contract=job.name)]
+        swc_ids = [self.swc_id]
+        job.result = {
+            "issues": issues, "swc_ids": swc_ids, "cache_hit": False,
+        }
+        if not job.finish(JobState.DONE):
+            return
+        self._count("jobs_done")
+        self.cache.put_solver_memo(job.key, {b"stub-digest": 1})
+        self.cache.put(
+            job.key, job.tx_count, job.modules, job.timeout,
+            issues, swc_ids, cold_wall_s=job.wall_s or 0.0,
+        )

@@ -1,0 +1,381 @@
+"""Gateway: routing, worker death + re-route, QoS shed, transports."""
+
+import json
+import socket
+
+import pytest
+
+from mythril_tpu.fleet.gateway import Gateway, GatewayServer
+from mythril_tpu.fleet.hashring import code_key
+from mythril_tpu.fleet.qos import AdmissionController
+
+
+class StubWorker:
+    """Scriptable worker handle: records requests, serves the op
+    surface the gateway forwards to, optionally fails on demand."""
+
+    def __init__(self, name, queue_full=False):
+        self.name = name
+        self.seen = []
+        self.next_id = 0
+        self.dead = False
+        self.queue_full = queue_full
+
+    def request(self, payload, timeout=None):
+        if self.dead:
+            raise ConnectionError("%s is dead" % self.name)
+        self.seen.append(payload)
+        op = payload.get("op")
+        if op == "submit":
+            if self.queue_full:
+                return {
+                    "ok": False, "kind": "backpressure",
+                    "error": "queue full", "retryable": True,
+                }
+            self.next_id += 1
+            return {"ok": True, "job_id": self.next_id}
+        if op in ("status", "result"):
+            return {
+                "ok": True, "job_id": payload["job_id"], "state": "done",
+                "cache_hit": False,
+                "result": {"issues": [], "swc_ids": []},
+            }
+        if op == "stats":
+            return {
+                "ok": True, "queued": 0, "queue_size": 16,
+                "breaker_state": "closed",
+                "cache": {"hits": 0, "misses": 0},
+            }
+        if op == "health":
+            return {"ok": True, "healthy": True}
+        if op == "metrics":
+            return {"ok": True, "metrics": "myth_stub_total 1\n"}
+        if op == "probe":
+            return {"ok": True, "key": "ab", "quarantined": False}
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        return {"ok": True}
+
+    def stream(self, payload, timeout=None):
+        if self.dead:
+            raise ConnectionError("%s is dead" % self.name)
+        yield {"ok": True, "event": "issue", "job_id": payload["job_id"],
+               "issue": {"title": "stub"}}
+        yield {"ok": True, "event": "end", "job_id": payload["job_id"],
+               "state": "done"}
+
+
+def make_gateway(n=2, **kw):
+    workers = [StubWorker("w%d" % i) for i in range(n)]
+    # tests submit in bursts; don't let the default QoS budget shed
+    # (test_qos_shed_* passes its own tight controller)
+    kw.setdefault(
+        "admission",
+        AdmissionController(base_rate_per_s=1000.0, burst=1000.0),
+    )
+    return Gateway(workers, **kw), workers
+
+
+def submit(gw, code="6001"):
+    return gw.handle({"op": "submit", "code": code, "name": "C"})
+
+
+# ------------------------------------------------------------------ routing
+
+
+def test_submit_routes_and_mints_gateway_job_id():
+    gw, workers = make_gateway()
+    resp = submit(gw)
+    assert resp["ok"]
+    name, _, wid = resp["job_id"].rpartition(":")
+    assert name == resp["worker"] and wid.isdigit()
+
+
+def test_duplicate_code_routes_to_same_worker():
+    gw, _ = make_gateway(n=4)
+    owners = {submit(gw, "6001")["worker"] for _ in range(8)}
+    assert len(owners) == 1
+
+
+def test_distinct_codes_spread():
+    gw, _ = make_gateway(n=2)
+    owners = {submit(gw, "60%02x" % i)["worker"] for i in range(64)}
+    assert len(owners) == 2
+
+
+def test_job_ops_reach_the_owning_worker():
+    gw, workers = make_gateway()
+    resp = submit(gw)
+    status = gw.handle({"op": "status", "job_id": resp["job_id"]})
+    assert status["ok"] and status["job_id"] == resp["job_id"]
+    owner = next(w for w in workers if w.name == resp["worker"])
+    assert any(p["op"] == "status" for p in owner.seen)
+
+
+def test_unknown_op_and_malformed_job_id():
+    gw, _ = make_gateway()
+    assert gw.handle({"op": "frobnicate"})["kind"] == "bad-request"
+    resp = gw.handle({"op": "status", "job_id": "nope"})
+    assert not resp["ok"] and resp["kind"] == "bad-request"
+
+
+# ------------------------------------------------- death, failover, reroute
+
+
+def test_submit_fails_over_when_owner_dies():
+    gw, workers = make_gateway()
+    first = submit(gw)
+    owner = next(w for w in workers if w.name == first["worker"])
+    owner.dead = True
+    second = submit(gw)  # same code: ring says the dead owner
+    assert second["ok"] and second["worker"] != owner.name
+    assert gw.worker_deaths == 1
+    assert owner.name not in gw.alive_workers()
+
+
+def test_job_reroutes_off_dead_worker():
+    gw, workers = make_gateway()
+    resp = submit(gw)
+    owner = next(w for w in workers if w.name == resp["worker"])
+    other = next(w for w in workers if w.name != resp["worker"])
+    owner.dead = True
+    status = gw.handle({"op": "status", "job_id": resp["job_id"]})
+    assert status["ok"]
+    assert status["job_id"] == resp["job_id"]  # the client's id survives
+    assert gw.reroutes == 1
+    assert any(p["op"] == "submit" for p in other.seen)  # resubmitted
+
+
+def test_all_workers_dead_is_a_structured_error():
+    gw, workers = make_gateway()
+    for w in workers:
+        w.dead = True
+    resp = submit(gw)
+    assert not resp["ok"] and resp["kind"] == "no-workers"
+    assert resp["retryable"]
+
+
+def test_health_tick_revives_recovered_worker():
+    gw, workers = make_gateway()
+    workers[0].dead = True
+    gw.health_tick()
+    assert workers[0].name not in gw.alive_workers()
+    workers[0].dead = False
+    gw.health_tick()
+    assert workers[0].name in gw.alive_workers()
+
+
+def test_backpressure_spills_to_other_worker():
+    full = StubWorker("full", queue_full=True)
+    free = StubWorker("free")
+    gw = Gateway([full, free])
+    # whatever the ring picks, the submission must land on `free`
+    for i in range(8):
+        resp = submit(gw, "60%02x" % i)
+        assert resp["ok"] and resp["worker"] == "free"
+
+
+def test_backpressure_everywhere_surfaces_backpressure():
+    gw = Gateway([StubWorker("a", queue_full=True),
+                  StubWorker("b", queue_full=True)])
+    resp = submit(gw)
+    assert not resp["ok"] and resp["kind"] == "backpressure"
+
+
+# --------------------------------------------------------------- streaming
+
+
+def test_watch_forwards_stream_with_gateway_ids():
+    gw, _ = make_gateway()
+    resp = submit(gw)
+    events = list(gw.handle_stream({"op": "watch", "job_id": resp["job_id"]}))
+    assert [e["event"] for e in events] == ["issue", "end"]
+    assert all(e["job_id"] == resp["job_id"] for e in events)
+
+
+def test_watch_reroutes_when_stream_dies():
+    gw, workers = make_gateway()
+    resp = submit(gw)
+    owner = next(w for w in workers if w.name == resp["worker"])
+    owner.dead = True
+    events = list(gw.handle_stream({"op": "watch", "job_id": resp["job_id"]}))
+    assert events[-1]["event"] == "end"
+    assert gw.reroutes == 1
+
+
+# ----------------------------------------------------------- QoS + fanout
+
+
+def test_qos_shed_is_structured_and_counted():
+    gw, _ = make_gateway(
+        admission=AdmissionController(base_rate_per_s=0.1, burst=1.0)
+    )
+    assert submit(gw)["ok"]
+    resp = submit(gw, "6002")
+    assert not resp["ok"] and resp["kind"] == "qos"
+    assert resp["retryable"] and resp["retry_after_s"] > 0
+
+
+def test_code_op_routes_by_key_or_explicit_worker():
+    gw, workers = make_gateway()
+    resp = gw.handle({"op": "probe", "code": "6001"})
+    assert resp["ok"] and resp["worker"] in ("w0", "w1")
+    expected = gw.ring.route(code_key("", "6001"))
+    assert resp["worker"] == expected
+    targeted = gw.handle({"op": "probe", "code": "6001", "worker": "w1"})
+    assert targeted["ok"] and targeted["worker"] == "w1"
+    bad = gw.handle({"op": "probe", "code": "6001", "worker": "nope"})
+    assert not bad["ok"] and bad["kind"] == "bad-request"
+
+
+def test_fleet_stats_and_health_aggregate():
+    gw, _ = make_gateway()
+    stats = gw.handle({"op": "fleet_stats"})
+    assert stats["ok"]
+    assert stats["gateway"]["workers_alive"] == 2
+    assert set(stats["workers"]) == {"w0", "w1"}
+    assert "level" in stats["admission"]
+    health = gw.handle({"op": "health"})
+    assert health["ok"] and health["healthy"]
+
+
+def test_fleet_metrics_include_gateway_and_workers():
+    gw, _ = make_gateway()
+    submit(gw)
+    resp = gw.handle({"op": "metrics"})
+    assert resp["ok"]
+    assert "myth_gateway_requests_total" in resp["metrics"]
+    assert resp["workers"]["w0"] == "myth_stub_total 1\n"
+
+
+# ---------------------------------------------------------- GatewayServer
+
+
+@pytest.fixture
+def served():
+    gw, workers = make_gateway()
+    server = GatewayServer(gw)
+    server.start()
+    yield server, gw, workers
+    server.stop()
+
+
+def _connect(server):
+    host, _, port = server.address.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=10)
+    sock.settimeout(10)
+    return sock
+
+
+def _line_request(server, payload):
+    with _connect(server) as sock:
+        sock.sendall(json.dumps(payload).encode() + b"\n")
+        buf = b""
+        while not buf.endswith(b"\n"):
+            buf += sock.recv(65536)
+    return json.loads(buf)
+
+
+def test_tcp_line_protocol_roundtrip(served):
+    server, _, _ = served
+    assert _line_request(server, {"op": "ping"})["pong"]
+    resp = _line_request(server, {"op": "submit", "code": "6001"})
+    assert resp["ok"] and ":" in resp["job_id"]
+
+
+def test_tcp_watch_streams_lines(served):
+    server, _, _ = served
+    resp = _line_request(server, {"op": "submit", "code": "6001"})
+    with _connect(server) as sock:
+        sock.sendall(json.dumps(
+            {"op": "watch", "job_id": resp["job_id"]}
+        ).encode() + b"\n")
+        buf = b""
+        while buf.count(b"\n") < 2:
+            buf += sock.recv(65536)
+    events = [json.loads(l) for l in buf.splitlines()]
+    assert [e["event"] for e in events] == ["issue", "end"]
+
+
+def test_http_get_health_and_stats(served):
+    server, _, _ = served
+    import http.client
+
+    host, _, port = server.address.rpartition(":")
+    for path, key in (("/health", "healthy"), ("/stats", "gateway")):
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        body = json.loads(resp.read())
+        assert body["ok"] and key in body
+        conn.close()
+
+
+def test_http_post_submit_and_metrics(served):
+    server, _, _ = served
+    import http.client
+
+    host, _, port = server.address.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    conn.request(
+        "POST", "/api",
+        body=json.dumps({"op": "submit", "code": "6001"}),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert json.loads(resp.read())["ok"]
+    conn.close()
+
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    assert resp.status == 200
+    assert "myth_gateway_requests_total" in text
+    assert "# worker w0" in text
+    conn.close()
+
+
+def test_http_watch_streams_ndjson(served):
+    server, gw, _ = served
+    resp = submit(gw)
+    with _connect(server) as sock:
+        body = json.dumps({"op": "watch", "job_id": resp["job_id"]})
+        sock.sendall(
+            ("POST /api HTTP/1.1\r\nContent-Length: %d\r\n\r\n%s"
+             % (len(body), body)).encode()
+        )
+        buf = b""
+        while b"\"end\"" not in buf:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, payload = buf.partition(b"\r\n\r\n")
+    assert b"x-ndjson" in head
+    events = [json.loads(l) for l in payload.splitlines() if l.strip()]
+    assert [e["event"] for e in events] == ["issue", "end"]
+
+
+def test_oversized_tcp_line_gets_structured_error(served):
+    server, _, _ = served
+    from mythril_tpu.fleet.transport import MAX_LINE_BYTES
+
+    with _connect(server) as sock:
+        sock.sendall(b"x" * (MAX_LINE_BYTES + 2))
+        buf = b""
+        while not buf.endswith(b"\n"):
+            buf += sock.recv(65536)
+        resp = json.loads(buf)
+        assert not resp["ok"] and resp["kind"] == "bad-request"
+        assert "exceeds" in resp["error"]
+        # the connection survives: finish the oversized line, then a
+        # well-formed request on the SAME socket still answers
+        sock.sendall(b"tail\n")
+        sock.sendall(json.dumps({"op": "ping"}).encode() + b"\n")
+        buf = b""
+        while not buf.endswith(b"\n"):
+            buf += sock.recv(65536)
+        assert json.loads(buf)["pong"]

@@ -1,0 +1,72 @@
+"""Consistent-hash ring: routing determinism, stability, failover order."""
+
+from mythril_tpu.fleet.hashring import HashRing, code_key
+from mythril_tpu.service.cache import cache_key
+
+
+def keys(n):
+    return [code_key("", "60%02x" % i) for i in range(n)]
+
+
+def test_code_key_matches_service_cache_key():
+    # the gateway routes on the SAME bytes the result cache keys on, so
+    # a duplicate submission lands where its warm entry lives
+    assert code_key("6080", "6001") == cache_key("6080", "6001")
+    assert code_key("", "6001") == cache_key("", "6001")
+
+
+def test_route_is_deterministic_and_member():
+    ring = HashRing(["a", "b", "c"])
+    for key in keys(64):
+        assert ring.route(key) == ring.route(key)
+        assert ring.route(key) in ("a", "b", "c")
+
+
+def test_route_spreads_over_nodes():
+    ring = HashRing(["a", "b", "c"])
+    owners = {ring.route(key) for key in keys(200)}
+    assert owners == {"a", "b", "c"}
+
+
+def test_route_order_is_failover_sequence():
+    ring = HashRing(["a", "b", "c", "d"])
+    for key in keys(32):
+        order = ring.route_order(key)
+        assert sorted(order) == ["a", "b", "c", "d"]  # all, no dups
+        assert order[0] == ring.route(key)
+
+
+def test_removal_only_remaps_removed_nodes_keys():
+    ring = HashRing(["a", "b", "c"])
+    before = {bytes(key): ring.route(key) for key in keys(200)}
+    ring.remove("b")
+    for key, owner in before.items():
+        if owner != "b":
+            # consistent hashing: surviving nodes keep their keys
+            assert ring.route(key) == owner
+        else:
+            assert ring.route(key) in ("a", "c")
+
+
+def test_add_restores_previous_ownership():
+    ring = HashRing(["a", "b", "c"])
+    before = {bytes(key): ring.route(key) for key in keys(100)}
+    ring.remove("b")
+    ring.add("b")
+    after = {bytes(key): ring.route(key) for key in keys(100)}
+    assert before == after
+
+
+def test_empty_ring_routes_nowhere():
+    ring = HashRing([])
+    assert len(ring) == 0
+    assert ring.route(code_key("", "6001")) is None
+    assert ring.route_order(code_key("", "6001")) == []
+
+
+def test_membership_and_len():
+    ring = HashRing(["a", "b"])
+    assert "a" in ring and "b" in ring and "c" not in ring
+    assert len(ring) == 2
+    ring.remove("a")
+    assert "a" not in ring and len(ring) == 1

@@ -1,0 +1,83 @@
+"""QoS admission: token buckets + live-metric auto-tuning."""
+
+from mythril_tpu.fleet.qos import AdmissionController, TokenBucket
+
+
+def stats(queued=0, queue_size=16, breaker="closed", hits=0, misses=0):
+    return {
+        "queued": queued,
+        "queue_size": queue_size,
+        "breaker_state": breaker,
+        "cache": {"hits": hits, "misses": misses},
+    }
+
+
+def test_bucket_burst_then_shed():
+    bucket = TokenBucket(rate_per_s=1.0, burst=3.0)
+    takes = [bucket.try_take()[0] for _ in range(5)]
+    assert takes[:3] == [True, True, True]
+    assert takes[3] is False
+    ok, retry_after = bucket.try_take()
+    assert not ok and retry_after > 0
+
+
+def test_idle_fleet_keeps_full_level():
+    qos = AdmissionController()
+    level = qos.observe({"w0": stats(), "w1": stats()})
+    assert level == 1.0
+
+
+def test_queue_pressure_lowers_level():
+    qos = AdmissionController()
+    level = qos.observe({"w0": stats(queued=12, queue_size=16)})
+    assert level < 0.5  # 75% full queues: admission throttles hard
+
+
+def test_dead_worker_counts_as_full_pressure():
+    qos = AdmissionController()
+    level = qos.observe({"w0": None, "w1": stats()})
+    assert level == qos.floor_level
+
+
+def test_open_breaker_clamps_to_floor():
+    qos = AdmissionController()
+    level = qos.observe({"w0": stats(breaker="open", hits=50, misses=0)})
+    assert level == qos.floor_level
+    snap = qos.snapshot()
+    assert snap["breaker_open"]
+
+
+def test_warm_rate_boosts_level():
+    qos = AdmissionController()
+    cold = qos.observe({"w0": stats(hits=0, misses=100)})
+    warm = qos.observe({"w0": stats(hits=100, misses=0)})
+    assert cold == 1.0
+    assert warm == 2.0  # dedup-heavy traffic is nearly free: 2x
+
+
+def test_admit_sheds_with_reason_and_retry_after():
+    qos = AdmissionController(base_rate_per_s=0.5, burst=1.0)
+    ok, reason, retry = qos.admit("tenant-a")
+    assert ok and reason is None
+    ok, reason, retry = qos.admit("tenant-a")
+    assert not ok and "tenant-a" in reason and retry > 0
+    # another tenant has its own bucket
+    assert qos.admit("tenant-b")[0]
+    snap = qos.snapshot()
+    assert snap["admitted"] == 2 and snap["shed"] == 1
+    assert snap["tenants"] == ["tenant-a", "tenant-b"]
+
+
+def test_shed_reason_names_queue_pressure():
+    qos = AdmissionController(base_rate_per_s=0.1, burst=1.0)
+    qos.observe({"w0": stats(queued=16, queue_size=16)})
+    qos.admit("t")
+    ok, reason, _ = qos.admit("t")
+    assert not ok and "capacity" in reason
+
+
+def test_empty_observation_keeps_level():
+    qos = AdmissionController()
+    qos.observe({"w0": stats(queued=16)})
+    lowered = qos.level
+    assert qos.observe({}) == lowered

@@ -1,0 +1,301 @@
+"""Durable shared warm store: persistence, sharing, crash recovery.
+
+The crash-recovery property test is the satellite contract from the
+fleet issue: kill -9 at ANY byte of the append path (simulated by
+truncating the log at every interesting offset) must reopen to a store
+holding every record fully written before the cut — values identical —
+with the torn tail dropped and counted, never a crash or a corrupt
+table.
+"""
+
+import glob
+import os
+import pickle
+import struct
+
+import pytest
+
+from mythril_tpu.fleet.hashring import code_key
+from mythril_tpu.fleet.store import DurableResultCache, DurableStore
+
+_HEADER = struct.Struct("<4sII")
+
+
+def wal_paths(root):
+    return sorted(glob.glob(os.path.join(str(root), "wal.*.log")))
+
+
+# --------------------------------------------------------------- raw store
+
+
+def test_append_get_roundtrip(tmp_path):
+    store = DurableStore(str(tmp_path))
+    store.append("result", "aa", {"t": 1.0, "v": "first"})
+    store.append("result", "bb", {"t": 1.0, "v": "second"})
+    assert store.get("result", "aa")["v"] == "first"
+    assert len(store.items("result")) == 2
+    assert store.stats()["appends"] == 2
+
+
+def test_latest_t_wins_for_results(tmp_path):
+    store = DurableStore(str(tmp_path))
+    store.append("result", "aa", {"t": 2.0, "v": "new"})
+    store.append("result", "aa", {"t": 1.0, "v": "stale"})
+    assert store.get("result", "aa")["v"] == "new"
+
+
+def test_memo_records_union_merge(tmp_path):
+    store = DurableStore(str(tmp_path))
+    store.append("memo", ("aa", 3), {b"d1": 1})
+    store.append("memo", ("aa", 3), {b"d2": 0})
+    assert store.get("memo", ("aa", 3)) == {b"d1": 1, b"d2": 0}
+
+
+def test_reopen_replays_log(tmp_path):
+    store = DurableStore(str(tmp_path))
+    for i in range(5):
+        store.append("result", "%02x" % i, {"t": float(i), "v": i})
+    # NO close/checkpoint: reopen must recover purely from the log
+    reopened = DurableStore(str(tmp_path))
+    assert len(reopened.items("result")) == 5
+    assert reopened.get("result", "03")["v"] == 3
+    assert reopened.replayed == 5
+
+
+def test_reopen_uses_checkpoint_then_tail(tmp_path):
+    store = DurableStore(str(tmp_path), checkpoint_every=3)
+    for i in range(7):  # two checkpoints + 1-record tail
+        store.append("result", "%02x" % i, {"t": float(i), "v": i})
+    assert store.checkpoints >= 2
+    reopened = DurableStore(str(tmp_path))
+    assert len(reopened.items("result")) == 7
+    # the snapshot covered most of the log: the tail replay is short
+    assert reopened.replayed <= 3
+
+
+def test_refresh_sees_sibling_appends(tmp_path):
+    a = DurableStore(str(tmp_path))
+    b = DurableStore(str(tmp_path))
+    a.append("result", "aa", {"t": 1.0, "v": "from-a"})
+    assert b.get("result", "aa") is None  # not yet refreshed
+    applied = b.refresh()
+    assert [(k, key) for k, key, _ in applied] == [("result", "aa")]
+    assert b.get("result", "aa")["v"] == "from-a"
+
+
+def test_torn_checkpoint_is_ignored(tmp_path):
+    store = DurableStore(str(tmp_path))
+    for i in range(4):
+        store.append("result", "%02x" % i, {"t": float(i), "v": i})
+    store.close()  # writes a good checkpoint
+    # a torn checkpoint from a dying sibling must not poison recovery
+    with open(os.path.join(str(tmp_path), "ckpt.999-1.pkl"), "wb") as f:
+        f.write(b"\x80\x04 definitely not a complete pickle")
+    reopened = DurableStore(str(tmp_path))
+    assert len(reopened.items("result")) == 4
+
+
+def _frame_offsets(blob):
+    """Byte offsets of each complete frame boundary in a wal blob."""
+    offsets = [0]
+    pos = 0
+    while pos + _HEADER.size <= len(blob):
+        _, _, length = _HEADER.unpack(blob[pos:pos + _HEADER.size])
+        pos += _HEADER.size + length
+        offsets.append(pos)
+    return offsets
+
+
+def test_crash_recovery_property(tmp_path):
+    """Truncate the log at every frame boundary and at bytes inside the
+    final frame (header-torn, payload-torn, crc-torn): reopening always
+    yields exactly the records fully contained before the cut, with
+    values equal to what was appended, and counts the torn tail."""
+    records = [
+        ("result", "%02x" % i, {"t": float(i), "v": os.urandom(8).hex()})
+        for i in range(6)
+    ]
+    seed_dir = tmp_path / "seed"
+    store = DurableStore(str(seed_dir))
+    for kind, key, value in records:
+        store.append(kind, key, value)
+    store._wal.flush()
+    [wal] = wal_paths(seed_dir)
+    blob = open(wal, "rb").read()
+    boundaries = _frame_offsets(blob)
+    assert len(boundaries) == len(records) + 1
+
+    # every frame boundary, plus cuts 1/3/7 bytes into each frame
+    cuts = set(boundaries)
+    for start, end in zip(boundaries, boundaries[1:]):
+        for delta in (1, 3, 7, _HEADER.size, _HEADER.size + 1):
+            if start + delta < end:
+                cuts.add(start + delta)
+
+    for cut in sorted(cuts):
+        root = tmp_path / ("cut%05d" % cut)
+        os.makedirs(str(root))
+        with open(os.path.join(str(root), os.path.basename(wal)), "wb") as f:
+            f.write(blob[:cut])
+        recovered = DurableStore(str(root))
+        n_complete = sum(1 for b in boundaries[1:] if b <= cut)
+        survivors = recovered.items("result")
+        assert len(survivors) == n_complete, "cut at %d" % cut
+        for kind, key, value in records[:n_complete]:
+            assert recovered.get(kind, key) == value, "cut at %d" % cut
+        if cut not in boundaries:
+            assert recovered.torn_records >= 1, "cut at %d" % cut
+        recovered.close()
+
+
+def test_torn_tail_then_continue_writing(tmp_path):
+    """After recovering from a torn log, the reopened store keeps
+    serving appends and a THIRD open sees old + new records."""
+    store = DurableStore(str(tmp_path))
+    store.append("result", "aa", {"t": 1.0, "v": "keep"})
+    store._wal.flush()
+    [wal] = wal_paths(tmp_path)
+    with open(wal, "ab") as f:
+        f.write(b"MYW1\x00torn")  # header fragment: kill -9 mid-append
+    second = DurableStore(str(tmp_path))
+    assert second.get("result", "aa")["v"] == "keep"
+    assert second.torn_records == 1
+    second.append("result", "bb", {"t": 2.0, "v": "new"})
+    third = DurableStore(str(tmp_path))
+    assert third.get("result", "aa")["v"] == "keep"
+    assert third.get("result", "bb")["v"] == "new"
+
+
+# ------------------------------------------------------ DurableResultCache
+
+
+KEY = code_key("", "6001600155")
+PARAMS = dict(tx_count=2, modules=None, timeout=60)
+
+
+def put_report(cache, key=KEY, issues=None):
+    return cache.put(
+        key, PARAMS["tx_count"], PARAMS["modules"], PARAMS["timeout"],
+        issues if issues is not None else [{"title": "finding"}],
+        ["101"], cold_wall_s=1.5,
+    )
+
+
+def get_report(cache, key=KEY):
+    return cache.get(
+        key, PARAMS["tx_count"], PARAMS["modules"], PARAMS["timeout"]
+    )
+
+
+def test_results_survive_restart(tmp_path):
+    cache = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    put_report(cache)
+    cache.close()
+    reopened = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    entry = get_report(reopened)
+    assert entry is not None
+    assert entry.issues == [{"title": "finding"}]
+    assert entry.swc_ids == ["101"]
+    # served from another incarnation's work: counts as cross-process
+    assert reopened.cross_process_hits == 1
+    reopened.close()
+
+
+def test_results_shared_across_live_processes(tmp_path):
+    a = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    b = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    put_report(a)
+    entry = get_report(b)
+    assert entry is not None and getattr(entry, "origin", None) == "peer"
+    assert b.cross_process_hits == 1
+    # a's own hit on its own entry is NOT cross-process
+    assert get_report(a) is not None
+    assert a.cross_process_hits == 0
+    a.close()
+    b.close()
+
+
+def test_param_mismatch_still_misses(tmp_path):
+    cache = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    put_report(cache)
+    assert cache.get(KEY, 5, None, 60) is None  # different tx_count
+    cache.close()
+
+
+def test_solver_memos_survive_and_merge(tmp_path):
+    a = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    b = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    a.put_solver_memo(KEY, {b"digest-a": 1})
+    b.put_solver_memo(KEY, {b"digest-b": 0})
+    assert a.get_solver_memo(KEY) == {b"digest-a": 1, b"digest-b": 0}
+    a.close()
+    b.close()
+    reopened = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    assert reopened.get_solver_memo(KEY) == {b"digest-a": 1, b"digest-b": 0}
+    reopened.close()
+
+
+def test_quarantine_survives_restart_and_is_shared(tmp_path):
+    a = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    b = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    a.force_quarantine(KEY, "operator says no")
+    assert b.is_quarantined(KEY)
+    assert b.quarantine_reason(KEY) == "operator says no"
+    a.close()
+    b.close()
+    reopened = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    assert reopened.is_quarantined(KEY)
+    assert reopened.lift_quarantine(KEY)
+    reopened.close()
+    # the lift is durable too
+    final = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    assert not final.is_quarantined(KEY)
+    final.close()
+
+
+def test_crash_strikes_accumulate_across_restarts(tmp_path):
+    a = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    a.record_crash(KEY, {"exception": "boom", "seam": "device"})
+    a.close()
+    b = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    # second strike in the next incarnation completes the quarantine
+    assert b.record_crash(KEY, {"exception": "boom2"}) == 2
+    assert b.is_quarantined(KEY)
+    b.close()
+
+
+def test_stats_carry_store_and_cross_process_counters(tmp_path):
+    cache = DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+    put_report(cache)
+    stats = cache.stats()
+    assert stats["store"]["appends"] == 1
+    assert stats["store"]["records"] == 1
+    assert stats["cross_process_hits"] == 0
+    assert stats["store"]["disk_bytes"] > 0
+    cache.close()
+
+
+def test_store_values_pickle_roundtrip_byte_identical(tmp_path):
+    """The recovered record VALUE is byte-identical under pickling to
+    what was appended — nothing lossy in the frame/replay path."""
+    value = {"t": 1.25, "issues": [{"title": "x", "extra": b"\x00\xff"}]}
+    store = DurableStore(str(tmp_path))
+    store.append("result", "aa", value)
+    store._wal.flush()
+    reopened = DurableStore(str(tmp_path))
+    assert pickle.dumps(reopened.get("result", "aa")) == pickle.dumps(value)
+
+
+@pytest.mark.parametrize("n_writers", [2, 3])
+def test_many_writers_one_truth(tmp_path, n_writers):
+    writers = [
+        DurableResultCache(str(tmp_path), refresh_interval_s=0.0)
+        for _ in range(n_writers)
+    ]
+    for i, writer in enumerate(writers):
+        put_report(writer, key=code_key("", "60%02x" % i))
+    for writer in writers:
+        for i in range(n_writers):
+            assert get_report(writer, key=code_key("", "60%02x" % i))
+    for writer in writers:
+        writer.close()

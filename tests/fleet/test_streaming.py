@@ -1,0 +1,118 @@
+"""Streaming partial results: the service ``watch`` seam.
+
+Drives the real scheduler + issue bus (pipeline stubbed): issue events
+must reach a watcher WHILE the job runs, replay for late watchers,
+replay source-tagged on cache hits, and flow over the socket protocol.
+"""
+
+import threading
+
+import pytest
+
+from mythril_tpu.service.api import (
+    SocketServer,
+    stream_over_socket,
+)
+
+from tests.fleet.stubs import FleetStubService
+
+
+@pytest.fixture
+def service():
+    svc = FleetStubService(workers=1, queue_size=8)
+    yield svc
+    svc.release.set()
+    svc.shutdown(wait=True, timeout=10)
+
+
+def test_issue_event_arrives_while_job_runs(service):
+    service.release.clear()
+    job_id = service.submit("6001600155", name="Streamed")
+    stream = service.watch(job_id, poll_s=0.01)
+    first = next(stream)
+    # the module fired mid-run: the job is NOT done yet
+    assert first["event"] == "issue"
+    assert first["issue"]["title"] == "Stubbed finding"
+    assert first["issue"]["contract"] == "Streamed"  # user-facing name
+    assert service.status(job_id)["state"] == "running"
+    service.release.set()
+    events = list(stream)
+    assert events[-1]["event"] == "end"
+    assert events[-1]["state"] == "done"
+    assert events[-1]["issues"] == 1
+    assert events[-1]["swc_ids"] == ["101"]
+
+
+def test_late_watcher_gets_full_replay(service):
+    job_id = service.submit("6001600155", name="Late")
+    assert service.wait(job_id, timeout=10)
+    events = list(service.watch(job_id, poll_s=0.01))
+    assert [e["event"] for e in events] == ["issue", "end"]
+
+
+def test_cache_hit_replays_issues_source_tagged(service):
+    code = "6002600255"
+    first = service.submit(code, name="Warm")
+    assert service.wait(first, timeout=10)
+    second = service.submit(code, name="Warm")
+    assert service.status(second)["cache_hit"]
+    events = list(service.watch(second, poll_s=0.01))
+    assert events[0]["event"] == "issue"
+    assert events[0]["source"] == "cache"  # never re-fired on the bus
+    assert events[-1]["event"] == "end" and events[-1]["cache_hit"]
+
+
+def test_two_services_do_not_cross_attribute(tmp_path):
+    """Two service instances in one process (the in-proc fleet test
+    mode): each job's issues reach only its own service's stream."""
+    a = FleetStubService(workers=1, queue_size=8)
+    b = FleetStubService(workers=1, queue_size=8)
+    try:
+        job_a = a.submit("6001600155", name="Same")
+        job_b = b.submit("6003600355", name="Same")
+        assert a.wait(job_a, timeout=10) and b.wait(job_b, timeout=10)
+        events_a = list(a.watch(job_a, poll_s=0.01))
+        events_b = list(b.watch(job_b, poll_s=0.01))
+        assert sum(1 for e in events_a if e["event"] == "issue") == 1
+        assert sum(1 for e in events_b if e["event"] == "issue") == 1
+    finally:
+        a.shutdown(wait=True, timeout=10)
+        b.shutdown(wait=True, timeout=10)
+
+
+def test_watch_over_socket(service, tmp_path):
+    path = str(tmp_path / "fleet-stream.sock")
+    server = SocketServer(service, path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        service.release.clear()
+        job_id = service.submit("6004600455", name="OverSocket")
+        stream = stream_over_socket(
+            path, {"op": "watch", "job_id": job_id}, timeout=10
+        )
+        first = next(stream)
+        assert first["ok"] and first["event"] == "issue"
+        service.release.set()
+        events = list(stream)
+        assert events[-1]["event"] == "end" and events[-1]["state"] == "done"
+    finally:
+        service.release.set()
+        server.stop()
+        thread.join(timeout=5)
+
+
+def test_watch_unknown_job_is_bad_request(service, tmp_path):
+    path = str(tmp_path / "fleet-badwatch.sock")
+    server = SocketServer(service, path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        events = list(stream_over_socket(
+            path, {"op": "watch", "job_id": 424242}, timeout=10
+        ))
+        assert len(events) == 1
+        assert not events[0]["ok"] and events[0]["kind"] == "bad-request"
+    finally:
+        server.stop()
+        thread.join(timeout=5)

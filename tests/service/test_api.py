@@ -133,3 +133,77 @@ def test_socket_server_cleans_up_stale_socket(tmp_path):
         server.stop()
         thread.join(timeout=5)
         service.shutdown(wait=True, timeout=10)
+
+
+def test_oversized_line_gets_structured_error_and_connection_survives(tmp_path):
+    """A client writing a line past MAX_REQUEST_BYTES must get ONE
+    structured bad-request (not a buffer blowup or a dropped socket),
+    and the same connection keeps serving well-formed requests."""
+    import socket as socket_mod
+
+    from mythril_tpu.service.api import MAX_REQUEST_BYTES
+
+    service = make_service()
+    path = str(tmp_path / "big.sock")
+    server = SocketServer(service, path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        sock = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        sock.settimeout(10)
+        sock.connect(path)
+        with sock:
+            sock.sendall(b"7" * (MAX_REQUEST_BYTES + 16))
+            buf = b""
+            while not buf.endswith(b"\n"):
+                buf += sock.recv(65536)
+            resp = json.loads(buf)
+            assert not resp["ok"]
+            assert resp["kind"] == "bad-request"
+            assert resp["retryable"] is False
+            assert "exceeds" in resp["error"]
+            # finish the oversized line; the connection must keep serving
+            sock.sendall(b"tail\n")
+            sock.sendall(json.dumps({"op": "ping"}).encode() + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                buf += sock.recv(65536)
+            assert json.loads(buf)["pong"]
+    finally:
+        server.stop()
+        thread.join(timeout=5)
+        service.shutdown(wait=True, timeout=10)
+
+
+def test_request_timeout_is_typed_and_retryable(tmp_path):
+    """A client timeout must surface as RequestTimeout with
+    retryable=True — the caller (gateway failover, scripts) can tell a
+    slow service from a malformed request."""
+    import socket as socket_mod
+
+    import pytest
+
+    from mythril_tpu.service.api import RequestTimeout
+
+    path = str(tmp_path / "tarpit.sock")
+    listener = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    listener.bind(path)
+    listener.listen(1)  # accepts, never answers
+
+    def tarpit():
+        try:
+            conn, _ = listener.accept()
+            threading.Event().wait(5)
+            conn.close()
+        except OSError:
+            pass
+
+    thread = threading.Thread(target=tarpit, daemon=True)
+    thread.start()
+    try:
+        with pytest.raises(RequestTimeout) as err:
+            request_over_socket(path, {"op": "ping"}, timeout=0.2)
+        assert err.value.retryable is True
+        assert isinstance(err.value, TimeoutError)
+    finally:
+        listener.close()

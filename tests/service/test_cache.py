@@ -101,3 +101,38 @@ def test_fact_schema_version_invalidates_solver_memos(monkeypatch):
     memo2 = {b"\x02" * 16: 30}
     cache.put_solver_memo(key, memo2)
     assert cache.get_solver_memo(key) == memo2
+
+
+def test_solver_memo_entry_lru_bound():
+    """The per-service memo table holds at most solver_memo_max code
+    hashes; the least-recently-touched entry is dropped and counted."""
+    cache = ResultCache()
+    cache.solver_memo_max = 3
+    keys = [cache_key("", "60%02x" % i) for i in range(4)]
+    for key in keys[:3]:
+        cache.put_solver_memo(key, {b"d": 1})
+    cache.get_solver_memo(keys[0])  # touch: keys[1] is now the LRU
+    cache.put_solver_memo(keys[3], {b"d": 1})
+    assert cache.get_solver_memo(keys[1]) is None
+    assert cache.get_solver_memo(keys[0]) is not None
+    stats = cache.stats()
+    assert stats["solver_memo_evictions"] == 1
+    assert stats["solver_memo_entries"] == 3
+
+
+def test_solver_memo_verdict_lru_bound():
+    """Within one code hash the digest set is bounded too: a hot
+    contract re-run under many parameter sets must not accrete verdicts
+    without limit. Oldest-merged digests evict first, recently
+    re-merged ones survive."""
+    cache = ResultCache()
+    cache.solver_memo_verdicts_max = 4
+    key = cache_key("", "6001")
+    cache.put_solver_memo(key, {b"d%d" % i: 1 for i in range(4)})
+    cache.put_solver_memo(key, {b"d0": 1})  # re-merge: d0 becomes MRU
+    cache.put_solver_memo(key, {b"d9": 0})  # evicts d1, not d0
+    memo = cache.get_solver_memo(key)
+    assert set(memo) == {b"d0", b"d2", b"d3", b"d9"}
+    stats = cache.stats()
+    assert stats["solver_verdict_evictions"] == 1
+    assert stats["solver_memo_verdicts"] == 4
