@@ -130,6 +130,34 @@ revert:
     REVERT
 """
 
+# In-loop-solve demonstration contract (ISSUE 19 acceptance): the
+# device fork on `x` followed by a fork on `ISZERO(x)` yields one
+# must-UNSAT child (x != 0 AND ISZERO(x) != 0) that enters an infinite
+# loop — it stays RUNNING until inloop_solve.unsat_mask's R3 rule kills
+# it MID-super-round (the sibling keeps the loop alive), which is
+# exactly the nonzero `in_loop_unsat_kills` the bench pins. The stress
+# contract's own forks are feasible until a solver sees them, so it can
+# legitimately report 0 here.
+INLOOP_DEMO_SRC = """
+    PUSH1 0x00
+    CALLDATALOAD            ; [x]
+    PUSH2 :a
+    JUMPI                   ; fork 1: taken asserts x != 0
+    STOP
+a:
+    JUMPDEST
+    PUSH1 0x00
+    CALLDATALOAD
+    ISZERO
+    PUSH2 :spin
+    JUMPI                   ; fork 2: taken asserts ISZERO(x) != 0
+    STOP
+spin:
+    JUMPDEST
+    PUSH2 :spin
+    JUMP                    ; the must-UNSAT child never halts on its own
+"""
+
 
 def _steady_analysis(
     creation_hex: str,
@@ -202,6 +230,16 @@ def _steady_analysis(
                 "fused_k_p50": _sample_pct(ks, 50),
                 "fused_k_p95": _sample_pct(ks, 95),
                 "device_pruned_lanes": tpu_strategy.device_pruned_lanes,
+                # in-loop solve + device storage addressing (ISSUE 19):
+                # must-UNSAT forks killed inside the fused while_loop,
+                # symbolic keccak-rooted keys resolved in the resident
+                # storage plane, and how often a lane still fell back
+                # to the TRAP_SS ring drain
+                "in_loop_unsat_kills": tpu_strategy.in_loop_unsat_kills,
+                "storage_device_resolved": (
+                    tpu_strategy.storage_device_resolved
+                ),
+                "trap_ss_drains": tpu_strategy.ss_drains,
                 # fused MESH accounting (docs/MESH.md): zero on a
                 # single-device run, populated when _mesh_tier shards
                 "steal_events": tpu_strategy.mesh_steal_events,
@@ -409,6 +447,23 @@ def _emit(progress: dict) -> None:
                 "fused_k_p50": progress.get("fused_k_p50"),
                 "fused_k_p95": progress.get("fused_k_p95"),
                 "device_pruned_lanes": progress.get("device_pruned_lanes"),
+                "in_loop_unsat_kills": progress.get("in_loop_unsat_kills"),
+                "storage_device_resolved": progress.get(
+                    "storage_device_resolved"
+                ),
+                "trap_ss_drains": progress.get("trap_ss_drains"),
+                "inloop_swc_parity_becstress": progress.get(
+                    "inloop_swc_parity_becstress"
+                ),
+                "inloop_swc_parity_bectoken": progress.get(
+                    "inloop_swc_parity_bectoken"
+                ),
+                "in_loop_unsat_kills_demo": progress.get(
+                    "in_loop_unsat_kills_demo"
+                ),
+                "demo_rounds_per_host_sync": progress.get(
+                    "demo_rounds_per_host_sync"
+                ),
                 "steal_events": progress.get("steal_events"),
                 "steal_volume_lanes": progress.get("steal_volume_lanes"),
                 "frontier_occupancy": progress.get("frontier_occupancy"),
@@ -1156,6 +1211,62 @@ def main() -> int:
             p95[phase_name] = round(v95 * 1000.0, 3)
     progress["round_phase_p50_ms"] = p50
     progress["round_phase_p95_ms"] = p95
+    _checkpoint(progress)
+
+    # in-loop solve A/B + demo (ISSUE 19): the OFF arms re-run both
+    # contracts with the kill switch thrown — the reported SWC issue
+    # set must not move (a device in-loop kill has to be
+    # indistinguishable from a host filter_feasible kill) — and a
+    # crafted contradiction contract demonstrates >=1 must-UNSAT fork
+    # killed inside a super-round.
+    import mythril_tpu.laser.tpu.backend as backend
+
+    _phase("inloop-ab: OFF arm (becstress, tx=2 budget=60)")
+    os.environ["MYTHRIL_TPU_INLOOP_SOLVE"] = "0"
+    try:
+        _, inloop_off_swcs, _, _ = _steady_analysis(
+            creation_hex, runtime.hex(), "tpu-batch", 2, 60, "BECStress"
+        )
+        _phase("inloop-ab: OFF arm (BECToken, tx=3 budget=120)")
+        _, bec_off_swcs, _, _ = _steady_analysis(
+            bec_creation, bec_runtime.hex(), "tpu-batch", 3, 120, "BECToken"
+        )
+    finally:
+        os.environ.pop("MYTHRIL_TPU_INLOOP_SOLVE", None)
+    progress["inloop_off_becstress_swcs"] = inloop_off_swcs
+    progress["inloop_swc_parity_becstress"] = (
+        inloop_off_swcs == integrated_swcs
+    )
+    progress["inloop_off_bectoken_swcs"] = bec_off_swcs
+    progress["inloop_swc_parity_bectoken"] = bec_off_swcs == bec_swcs
+    _checkpoint(progress)
+
+    _phase("inloop demo: crafted contradiction (tx=1 budget=45)")
+    demo_runtime = assemble(INLOOP_DEMO_SRC)
+    dn = len(demo_runtime)
+    demo_creation = (
+        assemble(
+            f"PUSH2 {dn}\nPUSH2 :code\nPUSH1 0x00\nCODECOPY\n"
+            f"PUSH2 {dn}\nPUSH1 0x00\nRETURN\ncode:"
+        ).hex()
+        + demo_runtime.hex()
+    )
+    # immediate engagement: the demo's forks must happen ON DEVICE for
+    # the in-loop kill to fire (the host's own fork-time is_possible
+    # check would kill the contradictory child before it ever ships)
+    saved_cfg = backend.DEFAULT_BATCH_CFG
+    backend.DEFAULT_BATCH_CFG = saved_cfg._replace(device_engage_after_s=0.0)
+    try:
+        _, _, _, demo_tpu = _steady_analysis(
+            demo_creation, demo_runtime.hex(), "tpu-batch", 1, 45,
+            "InloopDemo",
+        )
+    finally:
+        backend.DEFAULT_BATCH_CFG = saved_cfg
+    progress["in_loop_unsat_kills_demo"] = demo_tpu.get("in_loop_unsat_kills")
+    progress["demo_rounds_per_host_sync"] = demo_tpu.get(
+        "rounds_per_host_sync"
+    )
     _checkpoint(progress)
     _phase("done")
 

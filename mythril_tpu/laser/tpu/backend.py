@@ -80,7 +80,11 @@ DEFAULT_BATCH_CFG = BatchConfig(
     stack_slots=32,
     memory_bytes=1024,
     calldata_bytes=256,
-    storage_slots=16,
+    # 32 slots: the resident storage plane — symbolic keccak-rooted keys
+    # now land HERE (digest-probed, engine.py key_match) instead of
+    # freeze-trapping the lane, so mapping-heavy contracts fill slots
+    # that used to stay empty behind TRAP/TRAP_SS
+    storage_slots=32,
     code_len=8192,
     tape_slots=192,
     path_slots=32,
@@ -142,6 +146,13 @@ class TpuBatchStrategy(BasicSearchStrategy):
         self.fused_k_samples: List[int] = []
         self.device_pruned_lanes = 0
         self.device_wall_s = 0.0
+        # in-loop solve accounting (laser/tpu/inloop_solve.py): must-
+        # UNSAT forks killed INSIDE the fused while_loop (no lift, no
+        # decide_batch slot, super-round keeps running), and symbolic
+        # keccak-rooted storage keys that resolved into the device
+        # storage plane instead of freeze-trapping the lane
+        self.in_loop_unsat_kills = 0
+        self.storage_device_resolved = 0
         # fused-mesh accounting (docs/MESH.md): ICI work-steal exchanges
         # fired between super-round iterations, lanes they moved, and
         # the last observed per-shard frontier occupancy vector
@@ -670,6 +681,25 @@ FUSED_K_MAX = 64
 # super-round depth before any phase history exists to adapt from
 FUSED_K_DEFAULT = 16
 
+# steps per FUSED round (ISSUE 19): finer than the sync slice because
+# the in-loop UNSAT screen, REVERT-prune and lane compaction all run at
+# round boundaries — a doomed or halted lane stops burning step
+# iterations at the next boundary, so shorter rounds waste less work
+# (retired iterations = rounds x steps_per_round) and more rounds
+# amortize per host sync. Traced work per round is fixed-shape either
+# way; MYTHRIL_TPU_FUSED_STEPS pins it for bisection.
+FUSED_STEPS_PER_ROUND = 256
+
+
+def _fused_steps_per_round() -> int:
+    env_v = os.environ.get("MYTHRIL_TPU_FUSED_STEPS")
+    if env_v:
+        try:
+            return max(1, int(env_v))
+        except ValueError:
+            log.warning("bad MYTHRIL_TPU_FUSED_STEPS=%r ignored", env_v)
+    return FUSED_STEPS_PER_ROUND
+
 # EMA of device wall seconds per fused round — the adaptive-K
 # controller's denominator, updated after every fused dispatch
 _fused_round_cost_s = [0.0]
@@ -682,6 +712,19 @@ def _fused_enabled() -> bool:
     if mode == "on":
         return True
     return _retry.BREAKER.state() != "half-open"
+
+
+def _inloop_enabled() -> bool:
+    """MYTHRIL_TPU_INLOOP_SOLVE=0 is the kill switch for the in-loop
+    propagation-only UNSAT check (megakernel + inloop_solve): OFF runs
+    the exact pre-ISSUE-19 fused loop (with_solve is a static jit arg,
+    so the OFF specialization contains no solver code at all). Default
+    on. The ON/OFF equivalence test pins identical issue sets."""
+    return os.environ.get("MYTHRIL_TPU_INLOOP_SOLVE", "1").lower() not in (
+        "0",
+        "off",
+        "false",
+    )
 
 
 def _pick_fused_k() -> int:
@@ -903,6 +946,14 @@ def _run_mesh_fused(
     rounds_left = k
     hist = None
     pruned_visited = None
+    with_solve = _inloop_enabled()
+    # one pool per super-round, same cadence as the single-device path;
+    # run_fused_mesh replicates it across shards (P() in_spec)
+    pool = (
+        transfer.pool_to_device(solver_cache.GLOBAL.build_inloop_pool())
+        if with_solve
+        else None
+    )
     totals = {
         "k": k,
         "rounds": 0,
@@ -911,6 +962,7 @@ def _run_mesh_fused(
         "pruned_lanes": 0,
         "pruned_steps": 0,
         "pruned_static": 0,
+        "inloop_kills": 0,
         "device_wall_s": 0.0,
         "n_shards": n_shards,
         "steal_events": 0,
@@ -934,8 +986,10 @@ def _run_mesh_fused(
             env,
             st,
             max_rounds=dispatch,
-            steps_per_round=DEVICE_SLICE_STEPS,
+            steps_per_round=_fused_steps_per_round(),
             with_stats=want_stats,
+            with_solve=with_solve,
+            pool=pool,
         )
         st = fo.st
         stats = megakernel.decode_mesh_info(fo.info, n_shards)  # one fetch
@@ -946,7 +1000,10 @@ def _run_mesh_fused(
         totals["pruned_lanes"] += stats.pruned_lanes
         totals["pruned_steps"] += stats.pruned_steps
         totals["pruned_static"] += stats.pruned_static
+        totals["inloop_kills"] += stats.inloop_kills
         totals["device_wall_s"] += wall
+        if stats.inloop_kills:
+            _cat.INLOOP_UNSAT_KILLS_TOTAL.inc(stats.inloop_kills)
         totals["steal_events"] += stats.steal_events
         totals["steal_lanes"] += stats.steal_lanes
         totals["occupancy"] = list(stats.occupancy)
@@ -960,7 +1017,7 @@ def _run_mesh_fused(
                 lanes=stats.steal_lanes,
             )
             obs.TRACER.end_cut("mesh_steal")
-        if stats.pruned_lanes:
+        if stats.pruned_lanes or stats.inloop_kills:
             pv = np.asarray(fo.pruned_visited)
             pruned_visited = (
                 pv if pruned_visited is None else (pruned_visited | pv)
@@ -1016,6 +1073,16 @@ def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None)
     rounds_left = k
     hist = None
     pruned_visited = None
+    with_solve = _inloop_enabled()
+    # the pool is rebuilt once per super-round from the solver cache's
+    # recorded must-UNSAT sets: facts learned during THIS super-round's
+    # drain arrive next super-round (the in-loop check is a screen, not
+    # a verdict authority — see docs/SOLVER.md)
+    pool = (
+        transfer.pool_to_device(solver_cache.GLOBAL.build_inloop_pool())
+        if with_solve
+        else None
+    )
     totals = {
         "k": k,
         "rounds": 0,
@@ -1024,6 +1091,7 @@ def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None)
         "pruned_lanes": 0,
         "pruned_steps": 0,
         "pruned_static": 0,
+        "inloop_kills": 0,
         "device_wall_s": 0.0,
     }
     while rounds_left > 0:
@@ -1044,8 +1112,10 @@ def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None)
             default_env(),
             st,
             max_rounds=dispatch,
-            steps_per_round=DEVICE_SLICE_STEPS,
+            steps_per_round=_fused_steps_per_round(),
             with_stats=want_stats,
+            with_solve=with_solve,
+            pool=pool,
         )
         st = fo.st
         stats = megakernel.decode_info(fo.info)  # the one blocking fetch
@@ -1056,8 +1126,11 @@ def _run_device_fused(cb, st, cfg, want_stats=False, deadline=None, bridge=None)
         totals["pruned_lanes"] += stats.pruned_lanes
         totals["pruned_steps"] += stats.pruned_steps
         totals["pruned_static"] += stats.pruned_static
+        totals["inloop_kills"] += stats.inloop_kills
         totals["device_wall_s"] += wall
-        if stats.pruned_lanes:
+        if stats.inloop_kills:
+            _cat.INLOOP_UNSAT_KILLS_TOTAL.inc(stats.inloop_kills)
+        if stats.pruned_lanes or stats.inloop_kills:
             pv = np.asarray(fo.pruned_visited)
             pruned_visited = (
                 pv if pruned_visited is None else (pruned_visited | pv)
@@ -1642,6 +1715,16 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
                 _steps += fused["pruned_steps"]
                 strategy.static_pruned_lanes += fused["pruned_static"]
                 strategy.device_pruned_lanes += fused["pruned_lanes"]
+                strategy.in_loop_unsat_kills += fused.get("inloop_kills", 0)
+            # storage keys resolved on device this round: symbolic-key
+            # entries in the enlarged storage plane that previously froze
+            # the lane (TRAP) instead of probing
+            _sdr = int(
+                (np.asarray(out.skey_sym)[own_alive] > 0).sum()
+            )
+            if _sdr:
+                strategy.storage_device_resolved += _sdr
+                _cat.STORAGE_DEVICE_RESOLVED_TOTAL.inc(_sdr)
         else:
             own_alive = own_alive & job_mask
             _steps = int(np.asarray(out.steps)[job_mask].sum())
@@ -1752,9 +1835,13 @@ def exec_batch(laser, track_gas=False) -> Optional[List[GlobalState]]:
             feasible = filter_feasible(resumed_states)
         laser.work_list.extend(_apply_loop_bound(laser, feasible))
         # device-born forks add to the explored-state count — including
-        # forks that lived and died entirely on device (revert prune)
+        # forks that lived and died entirely on device (revert prune and
+        # in-loop must-UNSAT kills: a device-killed fork counts exactly
+        # like a host filter_feasible kill would have)
         _born_dead = (
-            fused["pruned_lanes"] if fused and job_mask is None else 0
+            fused["pruned_lanes"] + fused.get("inloop_kills", 0)
+            if fused and job_mask is None
+            else 0
         )
         laser.total_states += max(
             0, int(own_alive.sum()) + _born_dead - len(packed_states)

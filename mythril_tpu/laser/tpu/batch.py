@@ -201,7 +201,13 @@ class StateBatch(NamedTuple):
     msym_off: jnp.ndarray  # i32[L, MS] byte offset of a symbolic mem word
     msym_id: jnp.ndarray  # i32[L, MS]
     msym_used: jnp.ndarray  # bool[L, MS]
-    skey_sym: jnp.ndarray  # i32[L, K] storage key tags
+    # storage key tags. A tagged (symbolic) entry zeroes its concrete
+    # key word EXCEPT digits 0..7, which carry the key's 128-bit
+    # content digest (symtape.sha3_imm contract; 0 = none) so device
+    # probes match by content across node-id renumbering — consumers
+    # must check skey_sym first and never read a tagged entry's key
+    # word as a key value (read_storage_full callers lift the tag)
+    skey_sym: jnp.ndarray  # i32[L, K]
     sval_sym: jnp.ndarray  # i32[L, K] storage value tags
     calldata_symbolic: jnp.ndarray  # bool[L] calldata is a free symbol plane
     storage_symbolic: jnp.ndarray  # bool[L] world storage is symbolic
@@ -619,8 +625,10 @@ def read_storage_dict(st: StateBatch, lane: int) -> dict:
 def read_storage_full(st: StateBatch, lane: int):
     """All associative entries: [(key_int, val_int, key_tag, val_tag)].
 
-    A nonzero tag means the corresponding int is a zeroed placeholder and
-    the tape node (1-based id, see read_tape) is authoritative.
+    A nonzero tag means the corresponding int is a placeholder and the
+    tape node (1-based id, see read_tape) is authoritative. A tagged
+    key's int is NOT zero in general: its low 128 bits carry the key's
+    content-digest stamp (engine.py write_key) — never read it as a key.
     """
     used = np.asarray(st.storage_used)[lane]
     keys = np.asarray(st.storage_key)[lane].reshape(-1, words.NDIGITS)

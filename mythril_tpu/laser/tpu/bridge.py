@@ -35,7 +35,7 @@ from mythril_tpu.laser.evm.keccak_function_manager import keccak_function_manage
 from mythril_tpu.laser.evm.state.calldata import ConcreteCalldata
 from mythril_tpu.laser.evm.state.global_state import GlobalState
 from mythril_tpu.laser.evm.state.machine_state import MachineStack
-from mythril_tpu.laser.tpu import symtape, words
+from mythril_tpu.laser.tpu import solver_cache, symtape, words
 from mythril_tpu.laser.tpu.batch import (
     RUNNING,
     BatchConfig,
@@ -58,6 +58,7 @@ from mythril_tpu.smt import (
     symbol_factory,
 )
 from mythril_tpu.smt import terms
+from mythril_tpu.support.keccak import keccak256 as host_keccak256
 
 log = logging.getLogger(__name__)
 
@@ -504,7 +505,17 @@ class DeviceBridge:
         val3 = np_batch["storage_val"][lane].reshape(-1, words.NDIGITS)
         for j, (k_bv, v_bv) in enumerate(entries):
             if k_bv.symbolic:
-                np_batch["skey_sym"][lane, j] = lower_top(k_bv)
+                kid = lower_top(k_bv)
+                np_batch["skey_sym"][lane, j] = kid
+                # digest stamp (engine write_key contract): lets device
+                # probes match this entry by key content, not just node id
+                key3[j][: symtape.DIGEST_DIGITS] = symtape.key_digest_host(
+                    np_batch["tape_op"][lane],
+                    np_batch["tape_a"][lane],
+                    np_batch["tape_b"][lane],
+                    np_batch["tape_imm"][lane].reshape(-1, words.NDIGITS),
+                    kid,
+                )
             else:
                 key3[j] = _word(k_bv.value)  # view write-through
             if isinstance(v_bv, int):
@@ -617,16 +628,34 @@ class DeviceBridge:
         if len(word_terms) > 4:
             return None
         rest = 0
+        # canonical preimage digest (symtape.sha3_imm contract): must
+        # byte-match what engine.do_sha_sym computes on device for the
+        # same content, so host-packed and device-allocated SHA3 nodes
+        # CSE-unify and keccak-rooted storage keys resolve in-loop
+        records = bytearray()
         for t in reversed(word_terms):
             ea, imm = self._arg(np_batch, lane, t, rec)
+            if imm is not None:
+                rec_bytes = b"\x00" + int(t.value).to_bytes(32, "big")
+            else:
+                h1 = int(np_batch["tape_h1"][lane, ea - 1])
+                h2 = int(np_batch["tape_h2"][lane, ea - 1])
+                rec_bytes = (
+                    b"\x01"
+                    + h1.to_bytes(4, "big")
+                    + h2.to_bytes(4, "big")
+                    + b"\x00" * 24
+                )
+            records[:0] = rec_bytes  # preimage order (we walk reversed)
             rest = append_node(np_batch, lane, symtape.OP_COMB, ea, rest, imm)
+        digest = host_keccak256(bytes(records))[:16]
         return append_node(
             np_batch,
             lane,
             symtape.OP_SHA3,
             rest,
             0,
-            _word(32 * len(word_terms)),
+            symtape.sha3_imm(32 * len(word_terms), digest),
         )
 
     # ------------------------------------------------------------------
@@ -950,12 +979,31 @@ class DeviceBridge:
         )
 
     def lane_constraints(self, st: StateBatch, lane: int, values, side):
-        """The lane's accumulated path condition as host Bools."""
+        """The lane's accumulated path condition as host Bools.
+
+        This is the ONE place host path-literal terms meet their device
+        identities (tape_h1/tape_h2 of the condition node), so each
+        literal is registered with the solver cache here: when the host
+        later proves a set of these literals UNSAT, ``build_inloop_pool``
+        can compile that fact into the device-side in-loop clause pool
+        (inloop_solve.py) keyed by the same hashes.
+        """
         conds: List[Bool] = list(side)
+        h1s = np.asarray(st.tape_h1)[lane]
+        h2s = np.asarray(st.tape_h2)[lane]
         for node_id, sign in read_path(st, lane):
             w = values[node_id - 1]
             zero = symbol_factory.BitVecVal(0, 256)
-            conds.append(Not(w == zero) if sign else (w == zero))
+            cond = Not(w == zero) if sign else (w == zero)
+            raw = getattr(cond, "raw", None)
+            if raw is not None:
+                solver_cache.GLOBAL.note_path_literal(
+                    raw.uid,
+                    int(h1s[node_id - 1]),
+                    int(h2s[node_id - 1]),
+                    bool(sign),
+                )
+            conds.append(cond)
         return conds
 
     def unpack_lane(self, st: StateBatch, lane: int) -> GlobalState:

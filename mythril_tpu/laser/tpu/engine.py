@@ -519,13 +519,75 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     # distinct-input hashes as non-aliasing (keccak_function_manager.py's
     # disjoint output intervals), which is what justifies the syntactic
     # match below. Anything else leaves the device model.
-    probe_op = st.tape_op[lane, jnp.clip(sym_a - 1, 0, T - 1)]
-    key_sha3_ok = ~has_a | (probe_op == symtape.OP_SHA3)
+    probe_idx = jnp.clip(sym_a - 1, 0, T - 1)
+    probe_op = st.tape_op[lane, probe_idx]
+    imm3 = st.tape_imm.reshape(L, T, words.NDIGITS)
+    # direct keccak root: content digest straight off the SHA3 imm
+    # (symtape.sha3_imm; 0 = node predates digests / unknown preimage)
+    probe_is_sha = probe_op == symtape.OP_SHA3
+    sha_digest = imm3[lane, probe_idx][:, symtape.DIGEST_LO :]
+    # derived mapping-value key sha3(..) + offset: OP_ADD(sha3-ref, imm)
+    # in either operand order, offset below 2^128, base digest present.
+    # Its digest is base + offset mod 2^128 — still a pure function of
+    # content, and the keccak non-aliasing assumption already covers
+    # hash-plus-small-offset keys (struct/array slots stay inside the
+    # hash's disjoint output interval), so the syntactic-match
+    # justification carries over unchanged.
+    pa = st.tape_a[lane, probe_idx]
+    pb = st.tape_b[lane, probe_idx]
+    add_ref = jnp.where(pa > 0, pa, pb)
+    add_ref_idx = jnp.clip(add_ref - 1, 0, T - 1)
+    add_one_ref = ((pa > 0) & (pb == symtape.ARG_IMM)) | (
+        (pb > 0) & (pa == symtape.ARG_IMM)
+    )
+    add_imm = imm3[lane, probe_idx]
+    add_off_small = jnp.all(add_imm[:, symtape.DIGEST_LO :] == 0, axis=-1)
+    base_digest = imm3[lane, add_ref_idx][:, symtape.DIGEST_LO :]
+    probe_is_addsha = (
+        (probe_op == symtape.OP_ADD)
+        & add_one_ref
+        & (st.tape_op[lane, add_ref_idx] == symtape.OP_SHA3)
+        & add_off_small
+        & jnp.any(base_digest != 0, axis=-1)
+    )
+
+    def _digest_add(base, off):
+        # 8-digit ripple add, wrap mod 2^128
+        outs = []
+        carry = jnp.zeros((L,), U32)
+        for d in range(symtape.DIGEST_DIGITS):
+            s = base[:, d] + off[:, d] + carry
+            outs.append(s & jnp.uint32(0xFFFF))
+            carry = s >> 16
+        return jnp.stack(outs, axis=-1)
+
+    probe_digest = jnp.where(
+        probe_is_addsha[:, None],
+        _digest_add(base_digest, add_imm[:, : symtape.DIGEST_DIGITS]),
+        jnp.where(
+            probe_is_sha[:, None], sha_digest, jnp.zeros_like(sha_digest)
+        ),
+    )  # [L, 8]
+    key_sha3_ok = ~has_a | probe_is_sha | probe_is_addsha
     sym_key_trap = (is_sload | is_sstore) & has_a & ~key_sha3_ok
 
+    # symbolic-key match: node-id identity, OR content-digest identity
+    # for entries whose key carries a digest stamp (skey3 digits 0..7 of
+    # skey_sym>0 entries; see write_key below) — unifies keys that are
+    # structurally identical but allocated under different node ids
+    # (host-packed vs device-recomputed keccaks)
+    probe_has_digest = has_a & jnp.any(probe_digest != 0, axis=-1)
+    digest_match = (
+        (st.skey_sym > 0)
+        & probe_has_digest[:, None]
+        & jnp.all(
+            skey3[:, :, : symtape.DIGEST_DIGITS] == probe_digest[:, None, :],
+            axis=-1,
+        )
+    )
     key_match = st.storage_used & jnp.where(
         has_a[:, None],
-        st.skey_sym == sym_a[:, None],
+        (st.skey_sym == sym_a[:, None]) | digest_match,
         (st.skey_sym == 0) & jnp.all(skey3 == a[:, None, :], axis=-1),
     )  # [L, K]
     found = jnp.any(key_match, axis=-1)
@@ -663,9 +725,17 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     write_val = jnp.where((is_sstore & ~has_b)[:, None], b, jnp.zeros_like(b))
     write_val_sym = jnp.where(is_sstore, sym_b, sload_leaf_id)
     write_key_sym = jnp.where(has_a, sym_a, 0)
-    # symbolic keys zero the concrete plane (skey_sym is authoritative),
-    # matching write_val's zeroed-placeholder contract
-    write_key = jnp.where(has_a[:, None], jnp.zeros_like(a), a)
+    # symbolic keys zero the concrete plane (skey_sym is authoritative)
+    # EXCEPT digits 0..7, which carry the key's 128-bit content digest
+    # (0 = none) so later probes with a different node id but identical
+    # content still match; every consumer checks skey_sym first, so the
+    # stamp is invisible outside key_match (read_storage_full callers
+    # lift through the key tag, and the >=2^128 alias guard only looks
+    # at skey_sym == 0 entries)
+    digest_stamp = (
+        jnp.zeros_like(a).at[:, : symtape.DIGEST_DIGITS].set(probe_digest)
+    )
+    write_key = jnp.where(has_a[:, None], digest_stamp, a)
     new_storage_key = skey3.at[lane, store_slot].set(
         jnp.where(do_store[:, None], write_key, skey3[lane, store_slot])
     )
@@ -762,6 +832,7 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
     def do_sha_sym(tapes):
         rest = jnp.zeros((L,), I32)
         sha_ok = jnp.ones((L,), jnp.bool_)
+        recs = [None] * SHA_SYM_WORDS
         for k in range(SHA_SYM_WORDS - 1, -1, -1):
             woff = a32 + 32 * k
             active = sha_sym_mask & (k < nwords)
@@ -776,6 +847,31 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             wword = words.from_bytes_be(wbytes)
             comb_a = jnp.where(w_any, w_id, symtape.ARG_IMM)
             comb_imm = jnp.where(w_any[:, None], jnp.zeros_like(wword), wword)
+            # canonical digest record (symtape.sha3_imm contract): tag
+            # byte, then h1/h2 BE of the symbolic word's node or the raw
+            # concrete bytes — byte-identical to bridge._lower_keccak
+            w_tape_idx = jnp.clip(w_id - 1, 0, T - 1)
+            h1 = jnp.where(w_any, st.tape_h1[lane, w_tape_idx], 0).astype(U32)
+            h2 = jnp.where(w_any, st.tape_h2[lane, w_tape_idx], 0).astype(U32)
+            hbytes = jnp.stack(
+                [
+                    (h1 >> 24) & 0xFF, (h1 >> 16) & 0xFF,
+                    (h1 >> 8) & 0xFF, h1 & 0xFF,
+                    (h2 >> 24) & 0xFF, (h2 >> 16) & 0xFF,
+                    (h2 >> 8) & 0xFF, h2 & 0xFF,
+                ],
+                axis=-1,
+            ).astype(jnp.uint8)
+            body = jnp.where(
+                w_any[:, None],
+                jnp.concatenate(
+                    [hbytes, jnp.zeros((L, 24), jnp.uint8)], axis=-1
+                ),
+                wbytes.astype(jnp.uint8),
+            )
+            recs[k] = jnp.concatenate(
+                [w_any[:, None].astype(jnp.uint8), body], axis=-1
+            )
             tapes, comb_id, comb_ok = symtape.alloc(
                 tapes,
                 active,
@@ -787,13 +883,23 @@ def step_impl(cb: CodeBank, env: Env, st: StateBatch) -> StateBatch:
             )
             rest = jnp.where(active, comb_id, rest)
             sha_ok = sha_ok & comb_ok
+        records = jnp.concatenate(recs, axis=-1)  # [L, 33*SHA_SYM_WORDS]
+        d16 = keccak256_batch(
+            records, symtape.DIGEST_RECORD_BYTES * nwords
+        )  # [L, 32] digest bytes; only the first 16 are used
+        db = d16[:, :16].astype(U32)
+        sha_imm = (
+            words.from_u32(b32.astype(U32))
+            .at[:, symtape.DIGEST_LO :]
+            .set((db[:, 0::2] << 8) | db[:, 1::2])
+        )
         tapes, sha_id, sha3_ok = symtape.alloc(
             tapes,
             sha_sym_mask,
             jnp.full((L,), symtape.OP_SHA3, I32),
             rest,
             zero,
-            words.from_u32(b32.astype(U32)),
+            sha_imm,
             alloc_meta,
         )
         return tapes, sha_id, sha_ok & sha3_ok

@@ -1,0 +1,222 @@
+"""In-loop propagation-only UNSAT pruning for the fused super-round.
+
+The fused megakernel (megakernel.py) retires K rounds per host sync,
+but until ISSUE 19 every freshly forked lane had to survive to the
+super-round EXIT before `decide_batch` could kill it — a must-UNSAT
+fork rode up to K rounds of stepping, a download, and a lift before the
+host solver discarded it. This module is the device-side analogue of
+the solver cache's cheapest tiers: a fixed-shape, propagation-only
+check that runs INSIDE the ``lax.while_loop`` body, so provably
+infeasible forks die between rounds without ending the super-round.
+
+Two ingredients, both sound by construction:
+
+1. **Syntactic path contradiction** (pool-independent). Path entries
+   are (node id, sign) pairs with the exact semantics the bridge lifts
+   (``bridge.lane_constraints``): sign True asserts ``node != 0``,
+   sign False asserts ``node == 0``. Per-lane tape CSE
+   (``symtape._alloc_impl``) guarantees identical expressions share one
+   node id, so two entries on the SAME id with OPPOSITE signs are
+   ``x != 0 AND x == 0`` — UNSAT (rule R1). An entry on ``u`` and an
+   entry on ``ISZERO(u)`` carrying the SAME sign contradict the same
+   way (``u != 0 AND ISZERO(u) != 0`` resp. ``u == 0 AND
+   ISZERO(u) == 0`` — rule R3).
+
+2. **Clause-pool propagation** (host-seeded). ``solver_cache
+   .build_inloop_pool`` compiles its recorded must-UNSAT constraint
+   sets — the same facts that back UNSAT-superset subsumption — into
+   CNF clauses over (tape_h1, tape_h2) literal identities (the shared
+   prefix is effectively pre-blasted host-side, exactly like the
+   ``solver_jax._BlastTrie`` prefix reuse, but at word granularity so
+   the per-lane delta is just the lane's own path entries). A lane
+   whose path entries falsify a clause, directly or after a few unit
+   propagation sweeps, is a superset of a host-proved UNSAT set.
+
+Verdict-authority contract (docs/SOLVER.md): every kill decided here is
+subsumed by a host must-UNSAT verdict — R1/R3 are propagation-trivial
+for the host CDCL, and pool clauses are host verdicts verbatim. The
+device NEVER decides SAT and never overrides the memo/subsumption/
+rewrite stack; UNKNOWN lanes ride to the existing post-super-round
+``decide_batch`` drain unchanged. Killing a lane here is therefore
+indistinguishable from lifting it and watching ``filter_feasible``
+discard it — megakernel._one_round folds the dying lane's counter and
+coverage planes exactly like a REVERT prune, so measurement parity
+survives the skip.
+
+Everything in this file is pure jnp over fixed shapes: it runs inside
+the fused loop body on single-device AND under shard_map (all ops are
+lane-local; the pool is replicated), and the ``device_loop_purity``
+lint rule keeps host escapes out.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mythril_tpu.laser.tpu import symtape
+from mythril_tpu.laser.tpu.batch import RUNNING, StateBatch
+
+I8 = jnp.int8
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# unit-propagation sweeps per round: each sweep can only lengthen the
+# forced-assignment frontier by one clause hop, and the pool's clauses
+# are shallow (negations of flat UNSAT sets), so two sweeps saturate
+# everything observed in practice while keeping the loop body tiny
+PROP_SWEEPS = 2
+
+# default pool capacity (solver_cache.build_inloop_pool): vars are
+# distinct path-condition terms, clauses are recorded UNSAT sets of
+# width <= POOL_WIDTH. Fixed shapes — a bigger corpus is truncated to
+# the most recent facts, never reshaped mid-analysis.
+POOL_VARS = 64
+POOL_CLAUSES = 64
+POOL_WIDTH = 8
+
+
+class InloopPool(NamedTuple):
+    """Fixed-shape CNF pool, replicated across mesh shards.
+
+    A variable is a path-condition term identified by its content hash
+    (``symtape.node_hash`` h1/h2 — stable across fork copies and across
+    re-lowering, unlike lane-local node ids). A literal is (var index,
+    negated?); a clause is falsified when every used literal is false.
+    Construction is owned by ``solver_cache.build_inloop_pool`` (the
+    ``solver_boundary`` lint rule enforces this), which only emits
+    negations of host-proved UNSAT sets.
+    """
+
+    var_h1: jnp.ndarray  # u32[V] term content hash, half 1
+    var_h2: jnp.ndarray  # u32[V] term content hash, half 2
+    lit_var: jnp.ndarray  # i32[C, W] var index per literal
+    lit_neg: jnp.ndarray  # bool[C, W] literal wants var == False
+    lit_used: jnp.ndarray  # bool[C, W] literal slot populated
+
+
+def make_pool(var_h1, var_h2, lit_var, lit_neg, lit_used) -> InloopPool:
+    """Assemble a pool from device arrays (solver_cache only — the
+    solver_boundary lint rule rejects other construction sites)."""
+    return InloopPool(
+        var_h1=jnp.asarray(var_h1, U32),
+        var_h2=jnp.asarray(var_h2, U32),
+        lit_var=jnp.asarray(lit_var, I32),
+        lit_neg=jnp.asarray(lit_neg, jnp.bool_),
+        lit_used=jnp.asarray(lit_used, jnp.bool_),
+    )
+
+
+def empty_pool() -> InloopPool:
+    """The no-clauses pool: R1/R3 still fire, propagation is a no-op.
+
+    Minimal shapes keep the dormant arrays out of the carry budget."""
+    return make_pool(
+        jnp.zeros((1,), U32),
+        jnp.zeros((1,), U32),
+        jnp.zeros((1, 1), I32),
+        jnp.zeros((1, 1), jnp.bool_),
+        jnp.zeros((1, 1), jnp.bool_),
+    )
+
+
+def unsat_mask(pool: InloopPool, s: StateBatch) -> jnp.ndarray:
+    """bool[L]: RUNNING lanes whose path condition is provably UNSAT.
+
+    Pure jnp, lane-local, fixed shapes — safe inside the fused loop
+    body on single-device and under shard_map. Only RUNNING lanes are
+    eligible: halted/trapped lanes are the host's to lift, and their
+    filter_feasible verdict falls out of the normal drain.
+    """
+    L, Pn = s.path_id.shape
+    T = s.tape_op.shape[1]
+    lane = jnp.arange(L, dtype=I32)[:, None]
+    ids = s.path_id  # [L, P] 1-based node ids
+    valid = (jnp.arange(Pn, dtype=I32)[None, :] < s.path_len[:, None]) & (
+        ids > 0
+    )
+    idx = jnp.clip(ids - 1, 0, T - 1)
+    sign = s.path_sign
+
+    # ---- R1: same node asserted with both signs ----------------------
+    pair = valid[:, :, None] & valid[:, None, :]
+    r1 = jnp.any(
+        pair
+        & (ids[:, :, None] == ids[:, None, :])
+        & (sign[:, :, None] != sign[:, None, :]),
+        axis=(1, 2),
+    )
+
+    # ---- R3: u and ISZERO(u) asserted with the SAME sign -------------
+    ent_op = s.tape_op[lane, idx]
+    ent_a = s.tape_a[lane, idx]
+    is_isz = valid & (ent_op == symtape.OP_ISZERO) & (ent_a > 0)
+    r3 = jnp.any(
+        is_isz[:, :, None]
+        & valid[:, None, :]
+        & (ent_a[:, :, None] == ids[:, None, :])
+        & (sign[:, :, None] == sign[:, None, :]),
+        axis=(1, 2),
+    )
+
+    # ---- clause pool: seed assignments from the lane's path ----------
+    V = pool.var_h1.shape[0]
+    h1 = s.tape_h1[lane, idx]
+    h2 = s.tape_h2[lane, idx]
+    match = (
+        valid[:, :, None]
+        & (h1[:, :, None] == pool.var_h1[None, None, :])
+        & (h2[:, :, None] == pool.var_h2[None, None, :])
+    )  # [L, P, V]
+    pos = jnp.any(match & sign[:, :, None], axis=1)
+    neg = jnp.any(match & ~sign[:, :, None], axis=1)
+    # +1 asserted true, -1 asserted false, 0 unassigned (both-signs
+    # collapses to 0 here; R1 already kills that lane)
+    assign0 = pos.astype(I8) - neg.astype(I8)  # [L, V]
+
+    # literal one-hot over vars, flattened for the scatter-free fold of
+    # per-clause forced literals back onto the assignment vector (a
+    # bool-as-f32 matmul — MXU-friendly, no [L,C,W,V] intermediate)
+    lit_oh = (
+        (pool.lit_var[:, :, None] == jnp.arange(V, dtype=I32)[None, None, :])
+        & pool.lit_used[:, :, None]
+    )
+    oh_f = lit_oh.reshape(-1, V).astype(jnp.float32)  # [C*W, V]
+    n_used = jnp.sum(pool.lit_used, axis=-1)  # [C]
+    clause_active = n_used > 0
+
+    def sweep(_, carry):
+        assign, conflict = carry
+        lv = assign[:, pool.lit_var]  # [L, C, W]
+        lit_true = jnp.where(pool.lit_neg, lv < 0, lv > 0) & pool.lit_used
+        lit_false = jnp.where(pool.lit_neg, lv > 0, lv < 0) & pool.lit_used
+        n_true = jnp.sum(lit_true, axis=-1)
+        n_false = jnp.sum(lit_false, axis=-1)
+        # all literals false -> the lane's path includes a host-proved
+        # UNSAT set (or a consequence reached by propagation)
+        conflict = conflict | jnp.any(
+            clause_active & (n_true == 0) & (n_false == n_used), axis=-1
+        )
+        # unit clause: exactly one open literal, force it true
+        unit = clause_active & (n_true == 0) & (n_false == (n_used - 1))
+        open_lit = pool.lit_used & ~lit_true & ~lit_false
+        force_pos = (unit[:, :, None] & open_lit & ~pool.lit_neg).reshape(
+            L, -1
+        )
+        force_neg = (unit[:, :, None] & open_lit & pool.lit_neg).reshape(
+            L, -1
+        )
+        fp = (force_pos.astype(jnp.float32) @ oh_f) > 0  # [L, V]
+        fn = (force_neg.astype(jnp.float32) @ oh_f) > 0
+        conflict = conflict | jnp.any(
+            (fp & (assign < 0)) | (fn & (assign > 0)) | (fp & fn), axis=-1
+        )
+        assign = jnp.where(fp & (assign == 0), jnp.asarray(1, I8), assign)
+        assign = jnp.where(fn & (assign == 0), jnp.asarray(-1, I8), assign)
+        return assign, conflict
+
+    _, conflict = jax.lax.fori_loop(
+        0, PROP_SWEEPS, sweep, (assign0, jnp.zeros((L,), jnp.bool_))
+    )
+
+    return (r1 | r3 | conflict) & s.alive & (s.status == RUNNING)

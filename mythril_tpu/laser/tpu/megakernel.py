@@ -42,6 +42,26 @@ to the bridge.  A stable argsort on ``~alive`` keeps relative lane
 order among survivors (the S2 property test pins this down) and makes
 the alive frontier a dense prefix, which later forks refill and the
 host download can slice.
+
+In-loop-UNSAT soundness (ISSUE 19): with ``with_solve`` armed, each
+round additionally kills RUNNING lanes whose path condition
+``inloop_solve.unsat_mask`` proves UNSAT — by syntactic contradiction
+(same path node asserted with both signs, or a term against its own
+ISZERO) or by falsifying a clause ``solver_cache.build_inloop_pool``
+compiled from a host-proved must-UNSAT set.  Every such kill is
+therefore SUBSUMED by a host verdict: had the lane survived to the
+super-round exit, ``filter_feasible``'s memo/subsumption/propagation
+tiers would have discarded it before any detector or hook observed it
+(parked findings from hook replay are screened against the same UNSAT
+path condition and dropped).  Killing it on device produces the same
+observable result minus the lift — and exactly like the REVERT prune,
+the dying lane's steps/static_pruned/visited planes are folded into
+the fused accumulators (a separate ``in-loop kills`` counter rides the
+info vector) so counters and coverage stay indistinguishable from a
+host ``filter_feasible`` kill.  The device never decides SAT, never
+touches the verdict memo, and UNKNOWN lanes ride to the post-round
+``decide_batch`` drain unchanged; ``MYTHRIL_TPU_INLOOP_SOLVE=0``
+(backend) restores the exact pre-ISSUE-19 loop.
 """
 
 from functools import lru_cache, partial
@@ -52,6 +72,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from mythril_tpu import obs
+from mythril_tpu.laser.tpu import inloop_solve
 from mythril_tpu.laser.tpu import mesh as mesh_lib
 from mythril_tpu.laser.tpu.batch import (
     RUNNING,
@@ -77,9 +98,9 @@ class FusedOut(NamedTuple):
     """Result of one fused super-round dispatch."""
 
     st: StateBatch
-    # i32[6] packed scalars — ONE host fetch decodes all of them:
+    # i32[7] packed scalars — ONE host fetch decodes all of them:
     # [rounds_done, pruned_lanes, pruned_steps, pruned_static,
-    #  n_alive, n_running]
+    #  n_alive, n_running, inloop_kills]
     info: jnp.ndarray
     # bool[n_codes, code_len] union of PRUNED lanes' visited planes —
     # their coverage must still be harvested (measurement parity with
@@ -119,15 +140,20 @@ def _one_round(
     ps,
     px,
     pv,
+    uk,
+    pool,
     steps_per_round: int,
     with_stats: bool,
+    with_solve: bool,
 ):
     """One fused round: step ``steps_per_round`` times, REVERT-prune
-    (folding the dying lanes' counters into the accumulators), compact.
+    and (with ``with_solve``) in-loop-UNSAT-kill — folding the dying
+    lanes' counters into the accumulators either way — then compact.
 
     Shared verbatim by the single-device megakernel and the shard_map
-    mesh body — on a lane-sharded batch every op here is lane-local, so
-    GSPMD/shard_map partition it with zero communication."""
+    mesh body — on a lane-sharded batch every op here is lane-local
+    (the clause pool is replicated), so GSPMD/shard_map partition it
+    with zero communication."""
 
     def one_step(_, inner):
         s2, h = inner
@@ -142,26 +168,37 @@ def _one_round(
     # carry accumulators before the kill — the host merges them so
     # steps/coverage/static-prune accounting matches the lift path
     dead = prune_mask(cb, s)
+    # in-loop solve: must-UNSAT forks die here, mid-super-round, with
+    # the exact counter/coverage folds of the REVERT prune (module
+    # docstring, in-loop-UNSAT soundness). Tracked on its own
+    # accumulator so the seam metric (in_loop_unsat_kills) stays
+    # separable from static revert pruning.
+    if with_solve:
+        killed = inloop_solve.unsat_mask(pool, s) & ~dead
+    else:
+        killed = jnp.zeros_like(dead)
+    dying = dead | killed
     pl = pl + jnp.sum(dead.astype(I32))
-    ps = ps + jnp.sum(jnp.where(dead, s.steps, 0))
-    px = px + jnp.sum(jnp.where(dead, s.static_pruned, 0))
-    pv = pv.at[s.code_id].max(dead[:, None] & s.visited)
+    uk = uk + jnp.sum(killed.astype(I32))
+    ps = ps + jnp.sum(jnp.where(dying, s.steps, 0))
+    px = px + jnp.sum(jnp.where(dying, s.static_pruned, 0))
+    pv = pv.at[s.code_id].max(dying[:, None] & s.visited)
     # zero the dying lanes' counter planes: the host sums steps/
     # static_pruned over ALL lanes, so a stale copy left in a free
     # lane would double-count against the accumulators above
     s = s._replace(
-        alive=s.alive & ~dead,
-        steps=jnp.where(dead, 0, s.steps),
-        static_pruned=jnp.where(dead, 0, s.static_pruned),
-        visited=jnp.where(dead[:, None], False, s.visited),
+        alive=s.alive & ~dying,
+        steps=jnp.where(dying, 0, s.steps),
+        static_pruned=jnp.where(dying, 0, s.static_pruned),
+        visited=jnp.where(dying[:, None], False, s.visited),
     )
     s = compact_impl(s)
-    return s, hist, pl, ps, px, pv
+    return s, hist, pl, ps, px, pv, uk
 
 
 @partial(
     jax.jit,
-    static_argnames=("steps_per_round", "with_stats"),
+    static_argnames=("steps_per_round", "with_stats", "with_solve"),
     donate_argnames=("st",),
 )
 def _fused_impl(
@@ -169,38 +206,43 @@ def _fused_impl(
     env: Env,
     st: StateBatch,
     max_rounds,
+    pool,
     steps_per_round: int = 512,
     with_stats: bool = False,
+    with_solve: bool = False,
 ) -> FusedOut:
     """The megakernel body. ``max_rounds`` is TRACED (a runtime scalar),
     so the adaptive-K controller never triggers a recompile; only
-    ``steps_per_round``/``with_stats`` specialize the kernel."""
+    ``steps_per_round``/``with_stats``/``with_solve`` specialize the
+    kernel. The clause ``pool`` is traced too — solver_cache can refresh
+    clauses between dispatches without recompiling."""
     n_codes = cb.code.shape[0]
     W = st.visited.shape[1]
 
     def cond(carry):
-        r, s, _pl, _ps, _px, _pv, _hist = carry
+        r, s, _pl, _ps, _px, _pv, _uk, _hist = carry
         # needs_host reduction: RUNNING lanes still make device
         # progress; everything else is halted or frozen at a host op
         return (r < max_rounds) & jnp.any(s.alive & (s.status == RUNNING))
 
     def body(carry):
-        r, s, pl, ps, px, pv, hist = carry
-        s, hist, pl, ps, px, pv = _one_round(
-            cb, env, s, hist, pl, ps, px, pv,
+        r, s, pl, ps, px, pv, uk, hist = carry
+        s, hist, pl, ps, px, pv, uk = _one_round(
+            cb, env, s, hist, pl, ps, px, pv, uk, pool,
             steps_per_round=steps_per_round, with_stats=with_stats,
+            with_solve=with_solve,
         )
-        return r + 1, s, pl, ps, px, pv, hist
+        return r + 1, s, pl, ps, px, pv, uk, hist
 
     zero = jnp.asarray(0, I32)
     hist0 = jnp.zeros((256 if with_stats else 1,), jnp.uint32)
     pv0 = jnp.zeros((n_codes, W), jnp.bool_)
-    r, out, pl, ps, px, pv, hist = jax.lax.while_loop(
-        cond, body, (zero, st, zero, zero, zero, pv0, hist0)
+    r, out, pl, ps, px, pv, uk, hist = jax.lax.while_loop(
+        cond, body, (zero, st, zero, zero, zero, pv0, zero, hist0)
     )
     n_alive = jnp.sum(out.alive.astype(I32))
     n_running = jnp.sum((out.alive & (out.status == RUNNING)).astype(I32))
-    info = jnp.stack([r, pl, ps, px, n_alive, n_running])
+    info = jnp.stack([r, pl, ps, px, n_alive, n_running, uk])
     return FusedOut(st=out, info=info, pruned_visited=pv, hist=hist)
 
 
@@ -213,6 +255,7 @@ class FusedStats(NamedTuple):
     pruned_static: int
     n_alive: int
     n_running: int
+    inloop_kills: int
 
 
 def run_fused(
@@ -222,10 +265,14 @@ def run_fused(
     max_rounds: int,
     steps_per_round: int = 512,
     with_stats: bool = False,
+    with_solve: bool = False,
+    pool=None,
 ) -> FusedOut:
     """Dispatch one fused super-round (up to ``max_rounds`` device
     rounds without a host sync). The caller owns the single host fetch
     of ``out.info`` — nothing here blocks on device results."""
+    if pool is None:
+        pool = inloop_solve.empty_pool()  # noqa: clause-free pool, sound anywhere
     with obs.TRACER.span(
         "fused_super_round",
         tid="device",
@@ -237,8 +284,10 @@ def run_fused(
             env,
             st,
             jnp.asarray(int(max_rounds), I32),
+            pool,
             steps_per_round=steps_per_round,
             with_stats=with_stats,
+            with_solve=bool(with_solve),  # noqa: static python arg, not a tracer
         )
 
 
@@ -254,6 +303,7 @@ def decode_info(info) -> FusedStats:
         pruned_static=int(vals[3]),
         n_alive=int(vals[4]),
         n_running=int(vals[5]),
+        inloop_kills=int(vals[6]),
     )
 
 
@@ -266,12 +316,14 @@ _AX = "paths"
 
 
 class MeshFusedStats(NamedTuple):
-    """Host-side decode of the fused-MESH info vector (i32[8 + n_shards]).
+    """Host-side decode of the fused-MESH info vector
+    (i32[9 + n_shards]: eight scalars, the per-shard occupancy block,
+    then the in-loop kill count).
 
-    The first six fields mirror :class:`FusedStats`; the steal counters
-    and the per-shard frontier occupancy ride the SAME vector, so steal
-    accounting and occupancy gauges cost zero extra host syncs (the
-    whole point of folding them into ``info``)."""
+    The first six fields mirror :class:`FusedStats`; the steal
+    counters, the per-shard frontier occupancy, and the in-loop-UNSAT
+    kill count ride the SAME vector, so their accounting costs zero
+    extra host syncs (the whole point of folding them into ``info``)."""
 
     rounds: int
     pruned_lanes: int
@@ -282,6 +334,7 @@ class MeshFusedStats(NamedTuple):
     steal_events: int
     steal_lanes: int
     occupancy: tuple  # per-shard running lanes at loop exit
+    inloop_kills: int
 
 
 def decode_mesh_info(info, n_shards: int) -> MeshFusedStats:
@@ -299,27 +352,30 @@ def decode_mesh_info(info, n_shards: int) -> MeshFusedStats:
         steal_events=int(vals[6]),
         steal_lanes=int(vals[7]),
         occupancy=tuple(int(v) for v in vals[8 : 8 + n_shards]),
+        inloop_kills=int(vals[8 + n_shards]),
     )
 
 
 @lru_cache(maxsize=None)
-def _mesh_kernel(mesh, steps_per_round: int, with_stats: bool):
+def _mesh_kernel(mesh, steps_per_round: int, with_stats: bool, with_solve: bool):
     """Compile the fused super-round for one mesh shape.
 
     The whole megakernel loop runs INSIDE ``shard_map``: every shard
     owns a contiguous lane block (StateBatch planes sharded on the
-    leading axis, CodeBank/env replicated), the round body is the exact
-    single-device ``_one_round`` (lane-local, zero communication), and
-    the only collectives are deliberate — the psum quiescence check in
-    the loop cond, and the steal_plan/steal_apply all-gather +
-    all-to-all between rounds. Keyed on the (hashable, cached) Mesh so
-    repeated dispatches reuse one executable; ``max_rounds`` stays
-    traced exactly as on the single-device path."""
+    leading axis, CodeBank/env/clause-pool replicated), the round body
+    is the exact single-device ``_one_round`` (lane-local, zero
+    communication — the in-loop solve reads only the replicated pool
+    and the shard's own lanes), and the only collectives are
+    deliberate — the psum quiescence check in the loop cond, and the
+    steal_plan/steal_apply all-gather + all-to-all between rounds.
+    Keyed on the (hashable, cached) Mesh so repeated dispatches reuse
+    one executable; ``max_rounds`` stays traced exactly as on the
+    single-device path."""
     from jax.experimental.shard_map import shard_map
 
     n = mesh.devices.size
 
-    def shard_body(cb, env, st, max_rounds):
+    def shard_body(cb, env, st, max_rounds, pool):
         n_codes = cb.code.shape[0]
         W = st.visited.shape[1]
 
@@ -332,10 +388,11 @@ def _mesh_kernel(mesh, steps_per_round: int, with_stats: bool):
             return (r < max_rounds) & (jax.lax.psum(local, _AX) > 0)
 
         def body(carry):
-            r, s, pl, ps, px, pv, hist, sev, sln = carry
-            s, hist, pl, ps, px, pv = _one_round(
-                cb, env, s, hist, pl, ps, px, pv,
+            r, s, pl, ps, px, pv, uk, hist, sev, sln = carry
+            s, hist, pl, ps, px, pv, uk = _one_round(
+                cb, env, s, hist, pl, ps, px, pv, uk, pool,
                 steps_per_round=steps_per_round, with_stats=with_stats,
+                with_solve=with_solve,
             )
             # work-steal between rounds: the plan is derived from one
             # tiny all-gather, identical on every shard, so the cond
@@ -351,35 +408,42 @@ def _mesh_kernel(mesh, steps_per_round: int, with_stats: bool):
             s = jax.lax.cond(do_steal, _steal, lambda s_: s_, s)
             sev = sev + do_steal.astype(I32)
             sln = sln + jnp.where(do_steal, plan.moved, 0)
-            return r + 1, s, pl, ps, px, pv, hist, sev, sln
+            return r + 1, s, pl, ps, px, pv, uk, hist, sev, sln
 
         zero = jnp.asarray(0, I32)
         hist0 = jnp.zeros((256 if with_stats else 1,), jnp.uint32)
         pv0 = jnp.zeros((n_codes, W), jnp.bool_)
-        r, out, pl, ps, px, pv, hist, sev, sln = jax.lax.while_loop(
-            cond, body, (zero, st, zero, zero, zero, pv0, hist0, zero, zero)
+        r, out, pl, ps, px, pv, uk, hist, sev, sln = jax.lax.while_loop(
+            cond,
+            body,
+            (zero, st, zero, zero, zero, pv0, zero, hist0, zero, zero),
         )
 
         # fold the per-shard accumulators into mesh-wide replicated
-        # outputs; occupancy rides the same info vector (zero extra
-        # host syncs for gauges/steal gating)
+        # outputs; occupancy and the in-loop kill count ride the same
+        # info vector (zero extra host syncs for gauges/steal gating)
         running = out.alive & (out.status == RUNNING)
         occ = jax.lax.all_gather(jnp.sum(running.astype(I32)), _AX)
         n_alive = jax.lax.psum(jnp.sum(out.alive.astype(I32)), _AX)
         pl = jax.lax.psum(pl, _AX)
         ps = jax.lax.psum(ps, _AX)
         px = jax.lax.psum(px, _AX)
+        uk = jax.lax.psum(uk, _AX)
         pv = jax.lax.psum(pv.astype(jnp.uint32), _AX) > 0
         hist = jax.lax.psum(hist, _AX)
         info = jnp.concatenate(
-            [jnp.stack([r, pl, ps, px, n_alive, jnp.sum(occ), sev, sln]), occ]
+            [
+                jnp.stack([r, pl, ps, px, n_alive, jnp.sum(occ), sev, sln]),
+                occ,
+                uk[None],
+            ]
         )
         return FusedOut(st=out, info=info, pruned_visited=pv, hist=hist)
 
     sm = shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(), P(), P(_AX), P()),
+        in_specs=(P(), P(), P(_AX), P(), P()),
         out_specs=FusedOut(st=P(_AX), info=P(), pruned_visited=P(), hist=P()),
         check_rep=False,
     )
@@ -394,15 +458,20 @@ def run_fused_mesh(
     max_rounds: int,
     steps_per_round: int = 512,
     with_stats: bool = False,
+    with_solve: bool = False,
+    pool=None,
 ) -> FusedOut:
     """Dispatch one fused MESH super-round (sharded ``st``, replicated
-    ``cb``/``env``). As on the single-device path, nothing here blocks —
-    the caller owns the single ``info`` fetch (``decode_mesh_info``)."""
+    ``cb``/``env``/``pool``). As on the single-device path, nothing here
+    blocks — the caller owns the single ``info`` fetch
+    (``decode_mesh_info``)."""
     n = mesh.devices.size
     if st.pc.shape[0] % n != 0:
         raise ValueError(
             f"lane count {st.pc.shape[0]} not divisible by mesh size {n}"
         )
+    if pool is None:
+        pool = inloop_solve.empty_pool()  # noqa: clause-free pool, sound anywhere
     with obs.TRACER.span(
         "fused_super_round",
         tid="device",
@@ -410,5 +479,5 @@ def run_fused_mesh(
         steps_per_round=steps_per_round,
         shards=n,
     ):
-        fn = _mesh_kernel(mesh, steps_per_round, bool(with_stats))  # noqa: host-side cache key normalization
-        return fn(cb, env, st, jnp.asarray(int(max_rounds), I32))
+        fn = _mesh_kernel(mesh, steps_per_round, bool(with_stats), bool(with_solve))  # noqa: host-side cache key normalization
+        return fn(cb, env, st, jnp.asarray(int(max_rounds), I32), pool)

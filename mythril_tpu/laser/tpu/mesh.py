@@ -22,7 +22,12 @@ Two tiers consume this module (docs/MESH.md):
   * the FUSED mesh path (megakernel.run_fused_mesh) runs the whole
     super-round inside ``shard_map`` and calls :func:`steal_plan` /
     :func:`steal_apply` between rounds — an explicit ICI all-to-all
-    work-steal that never leaves the device;
+    work-steal that never leaves the device. The in-loop UNSAT check
+    (laser/tpu/inloop_solve.py) composes with this tier for free: the
+    clause pool is replicated (``P()`` in-spec), the check itself is
+    lane-local, and only its kill COUNTER is psum'd into the shared
+    info vector — killed lanes simply read as idle capacity to the
+    next steal exchange;
   * the SYNC degrade tier (backend ``_run_device``) keeps the legacy
     one-round-per-dispatch loop, gated by the device-computed occupancy
     vector ``round_impl`` now returns (no extra host fetch).

@@ -538,6 +538,9 @@ _STAT_KEYS = (
     "rewrite_discharged",  # sets decided by rewrite/interval discharge
     "assumption_reuse",  # sets answered SAT by ancestor-witness replay
     "core_minimized",  # UNSAT verdicts whose prefix core was shortened
+    # in-loop solve pool (ISSUE 19, laser/tpu/inloop_solve.py)
+    "inloop_pool_builds",  # clause pools compiled for the fused loop
+    "inloop_pool_clauses",  # last pool's clause count (assigned, not summed)
 )
 
 
@@ -570,6 +573,17 @@ class SolverCache:
         self._rewrite_time_s = 0.0
         self._rw_bits_before = 0
         self._rw_bits_after = 0
+        # term uid -> (h1, h2, sign): the device-literal identity of a
+        # path-condition term, registered by bridge.lane_constraints at
+        # lift time (symtape.node_hash is content-addressed, so the
+        # SAME condition re-lowered in a later round or a sibling lane
+        # hashes identically). Backs build_inloop_pool — only sets
+        # whose every member has a registered literal can be compiled
+        # into in-loop clauses.
+        self._term_lits: "OrderedDict[int, Tuple[int, int, bool]]" = (
+            OrderedDict()
+        )
+        self.max_term_lits = 8192
         self.pool: Optional[FallbackPool] = None
 
     # -- internals ------------------------------------------------------
@@ -700,6 +714,95 @@ class SolverCache:
                 if model is not None:
                     return model
         return None
+
+    # -- in-loop solve pool (ISSUE 19) ------------------------------------
+
+    def note_path_literal(self, uid: int, h1: int, h2: int, sign: bool) -> None:
+        """Register a path-condition term's device-literal identity.
+
+        Called by the bridge at lift time for every path entry it turns
+        into a host constraint: ``uid`` is the hash-consed term uid the
+        memo/subsumption tables key on, ``(h1, h2)`` the symtape content
+        hash of the underlying word, ``sign`` the branch direction
+        (True asserts word != 0). Idempotent; bounded LRU."""
+        with self._lock:
+            self._term_lits[uid] = (int(h1), int(h2), bool(sign))
+            self._term_lits.move_to_end(uid)
+            while len(self._term_lits) > self.max_term_lits:
+                self._term_lits.popitem(last=False)
+
+    def build_inloop_pool(self, max_vars=None, max_clauses=None, max_width=None):
+        """Compile the recorded must-UNSAT sets into the fixed-shape
+        in-loop CNF pool (inloop_solve.InloopPool).
+
+        Every emitted clause is the negation of one ``_unsat_sets``
+        entry — a constraint set a HOST decider proved UNSAT — whose
+        members all have registered device literals, so a device kill
+        against this pool is subsumed by a host verdict by
+        construction (docs/SOLVER.md verdict-authority contract). Sets
+        wider than ``max_width`` or touching unregistered terms are
+        skipped (they stay host-only); most-recent facts win the fixed
+        clause budget. Always returns a FULL-CAPACITY pool (unused
+        clause slots inert) so the megakernel sees one stable shape;
+        with no usable facts the kernel's syntactic R1/R3 rules still
+        fire."""
+        from mythril_tpu.laser.tpu import inloop_solve
+
+        if max_vars is None:
+            max_vars = inloop_solve.POOL_VARS
+        if max_clauses is None:
+            max_clauses = inloop_solve.POOL_CLAUSES
+        if max_width is None:
+            max_width = inloop_solve.POOL_WIDTH
+        with self._lock:
+            unsat_sets = list(self._unsat_sets)
+            lits = dict(self._term_lits)
+        var_index: Dict[Tuple[int, int], int] = {}
+        clauses: List[List[Tuple[int, bool]]] = []
+        for fs in reversed(unsat_sets):  # most recent first
+            if len(clauses) >= max_clauses:
+                break
+            if not 0 < len(fs) <= max_width:
+                continue
+            entry = [lits.get(uid) for uid in fs]
+            if any(e is None for e in entry):
+                continue
+            # distinct terms can share a word with opposite signs; both
+            # map onto ONE var with literal polarity = sign
+            need = {(h1, h2) for (h1, h2, _sign) in entry}
+            new = [v for v in need if v not in var_index]
+            if len(var_index) + len(new) > max_vars:
+                continue
+            for v in new:
+                var_index[v] = len(var_index)
+            clauses.append(
+                [(var_index[(h1, h2)], sign) for (h1, h2, sign) in entry]
+            )
+        with self._lock:
+            self._stats["inloop_pool_builds"] += 1
+            self._stats["inloop_pool_clauses"] = len(clauses)
+        # ALWAYS full-capacity shapes: the pool feeds a static-shape
+        # megakernel argument, so a content-sized pool would force an
+        # XLA recompile the moment the first fact lands mid-analysis.
+        # Unused slots are inert (lit_used False -> clause inactive).
+        V, C, W = max_vars, max_clauses, max_width
+        var_h1 = [0] * V
+        var_h2 = [0] * V
+        for (h1, h2), i in var_index.items():
+            var_h1[i] = h1
+            var_h2[i] = h2
+        lit_var = [[0] * W for _ in range(C)]
+        lit_neg = [[False] * W for _ in range(C)]
+        lit_used = [[False] * W for _ in range(C)]
+        for ci, clause in enumerate(clauses):
+            for wi, (vi, sign) in enumerate(clause):
+                lit_var[ci][wi] = vi
+                # the UNSAT set asserted (word == sign); the clause is
+                # its negation, so the literal wants the opposite:
+                # sign True  -> literal satisfied when word == 0
+                lit_neg[ci][wi] = sign
+                lit_used[ci][wi] = True
+        return inloop_solve.make_pool(var_h1, var_h2, lit_var, lit_neg, lit_used)
 
     # -- the round-loop entry point --------------------------------------
 
@@ -1049,6 +1152,7 @@ class SolverCache:
             self._alpha.clear()
             self._unsat_sets.clear()
             self._models.clear()
+            self._term_lits.clear()
             self._stats = {k: 0 for k in _STAT_KEYS}
             self._time_s = 0.0
             self._rewrite_time_s = 0.0

@@ -29,6 +29,15 @@ power-of-two buckets (vars/clauses/batch) so recompiles are rare; the
 search itself is lax.while_loop'd scalar-free vector work that maps onto
 the VPU. Clause width is fixed at 3 (the Blaster's gate layer emits only
 1..3-literal clauses), so the clause matrix is [I, C, 3] int32 in HBM.
+
+A third, even cheaper propagation tier lives INSIDE the fused round
+loop (laser/tpu/inloop_solve.py): where this module bit-blasts full
+formulas post-super-round (the shared prefix cached by ``_BlastTrie``),
+the in-loop kernel works at WORD granularity over clauses the solver
+cache compiled from already-proved UNSAT sets — phase-1-style unit
+propagation only, no search, so a freshly forked must-UNSAT lane dies
+between rounds without ending the super-round or reaching this module
+at all. Lanes it cannot settle arrive here unchanged.
 """
 
 import logging

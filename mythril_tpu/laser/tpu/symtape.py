@@ -30,6 +30,8 @@ import jax
 import numpy as np
 import jax.numpy as jnp
 
+from mythril_tpu.laser.tpu import words
+
 ARG_NONE = 0
 ARG_IMM = -1
 
@@ -183,6 +185,81 @@ def path_fingerprint(h1, h2, signs):
             acc = acc ^ (acc >> np.uint64(29))
             out[j] = acc
     return out
+
+
+# --- keccak preimage digests ------------------------------------------------
+# OP_SHA3 imm digits 0..DIGEST_LO-1 carry the preimage BYTE LENGTH (the
+# words.from_int low half); digits DIGEST_LO..15 carry a 128-bit content
+# digest of the canonical preimage encoding below. The digest is a pure
+# function of the preimage's content (concrete bytes / symbolic-word
+# identity hashes), computed identically by the device engine
+# (engine ``do_sha_sym`` via keccak256_batch) and the host packer
+# (bridge._lower_keccak via support.keccak), so a SHA3 node lowered on
+# the host and one allocated on device CSE-match, and storage keys
+# rooted at structurally identical keccak preimages unify WITHOUT a
+# host round trip. Digest 0 means "no digest recorded" (legacy nodes,
+# unrepresentable preimages): consumers MUST fall back to node-id
+# identity and never treat two zero digests as equal content.
+#
+# Canonical encoding: one DIGEST_RECORD_BYTES-byte record per 32-byte
+# preimage word, preimage order, then digest128 = first 16 bytes of
+# keccak256(records):
+#   byte 0       1 if the word is symbolic else 0
+#   bytes 1..32  symbolic: h1 (4B BE) + h2 (4B BE) + 24 zero bytes
+#                concrete: the raw word, big-endian
+
+DIGEST_RECORD_BYTES = 33
+DIGEST_LO = 8  # first imm digit of the digest
+DIGEST_DIGITS = 8  # 8 digits x 16 bits = 128-bit digest
+
+
+def digest_digits(digest16) -> np.ndarray:
+    """Pack the first 16 digest bytes into 8 imm digits (host numpy):
+    digit d = (byte[2d] << 8) | byte[2d+1], matching the device packer
+    in engine.py."""
+    b = np.frombuffer(bytes(digest16[:16]), dtype=np.uint8).astype(np.uint32)
+    return (b[0::2] << np.uint32(8)) | b[1::2]
+
+
+def sha3_imm(nbytes: int, digest16=None) -> np.ndarray:
+    """The canonical OP_SHA3 imm word: preimage byte length in the low
+    digits, optional 128-bit content digest in digits DIGEST_LO..15."""
+    imm = words.from_int(int(nbytes))
+    if digest16 is not None:
+        imm[DIGEST_LO : DIGEST_LO + DIGEST_DIGITS] = digest_digits(digest16)
+    return imm
+
+
+def key_digest_host(ops, aa, bb, imm3, node_id) -> np.ndarray:
+    """uint32[DIGEST_DIGITS] content digest of a storage-key node, host
+    mirror of the engine's in-loop probe-digest logic. Zeros = no digest.
+
+    Accepts a direct OP_SHA3 node (digest straight off the imm) or the
+    derived mapping-value form OP_ADD(sha3-ref, imm) with the offset
+    below 2^128, whose digest is base + offset mod 2^128 — the same
+    definition the device uses, so host-stamped storage entries and
+    device probes agree."""
+    i = int(node_id) - 1
+    if i < 0:
+        return np.zeros(DIGEST_DIGITS, np.uint32)
+    op = int(ops[i])
+    if op == OP_SHA3:
+        return np.asarray(imm3[i][DIGEST_LO:], np.uint32).copy()
+    if op == OP_ADD:
+        a_, b_ = int(aa[i]), int(bb[i])
+        ref, other = (a_, b_) if a_ > 0 else (b_, a_)
+        if ref > 0 and other == ARG_IMM and int(ops[ref - 1]) == OP_SHA3:
+            off = np.asarray(imm3[i], np.uint64)
+            base = np.asarray(imm3[ref - 1][DIGEST_LO:], np.uint64)
+            if int(off[DIGEST_LO:].sum()) == 0 and int(base.sum()) != 0:
+                out = np.zeros(DIGEST_DIGITS, np.uint32)
+                carry = 0
+                for d in range(DIGEST_DIGITS):
+                    s = int(base[d]) + int(off[d]) + carry
+                    out[d] = s & 0xFFFF
+                    carry = s >> 16
+                return out
+    return np.zeros(DIGEST_DIGITS, np.uint32)
 
 
 HOST_META = 0xFFFFFFFF  # tape_meta sentinel: node packed by the host

@@ -181,6 +181,17 @@ def upload_segments(arrays):
     return _split_jit(jnp.asarray(serialize_segments(arrays)), spec)
 
 
+def pool_to_device(pool):
+    """Pin the in-loop CNF pool (inloop_solve.InloopPool) on device once
+    per super-round: the pool rides every fused dispatch as a kernel
+    argument, and without an explicit device_put each dispatch would
+    re-stage the five host-built arrays over the wire. The pool is tiny
+    (a few KB), but the transfer sits on the dispatch critical path —
+    the exact seam this tier exists to keep empty."""
+    faults.fire(faults.TRANSFER_UP, context="pool_to_device")
+    return jax.device_put(pool)
+
+
 def batch_to_device(np_batch: dict, cfg) -> StateBatch:
     """Host plane dict -> device StateBatch via one upload.
 

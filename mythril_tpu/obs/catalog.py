@@ -57,6 +57,20 @@ CNF_CLAUSES_TOTAL = REGISTRY.counter(
     "myth_cnf_clauses_total", "CNF clauses blasted for device dispatch"
 )
 
+# -- in-loop solve + resident storage plane (laser/tpu/inloop_solve.py,
+#    engine.py keccak storage addressing, backend._run_device_fused) ---
+
+INLOOP_UNSAT_KILLS_TOTAL = REGISTRY.counter(
+    "myth_inloop_unsat_kills_total",
+    "must-UNSAT forks killed inside the fused while_loop (no lift, no "
+    "host solve; subsumed by host verdicts per docs/SOLVER.md)",
+)
+STORAGE_DEVICE_RESOLVED_TOTAL = REGISTRY.counter(
+    "myth_storage_device_resolved_total",
+    "symbolic keccak-rooted storage keys resolved into the device "
+    "storage plane instead of freeze-trapping the lane",
+)
+
 # -- fused mesh path (laser/tpu/mesh.py, backend._run_mesh_fused) ------
 
 # last observed running-lane count per shard, set from the fused info
@@ -247,6 +261,17 @@ def _solver_samples():
         ),
         ("myth_solver_core_minimized_total", (), snap["core_minimized"]),
         ("myth_solver_rewrite_time_s", (), snap["rewrite_time_s"]),
+        # in-loop clause pool (laser/tpu/inloop_solve.py)
+        (
+            "myth_solver_inloop_pool_builds_total",
+            (),
+            snap["inloop_pool_builds"],
+        ),
+        (
+            "myth_solver_inloop_pool_clauses_total",
+            (),
+            snap["inloop_pool_clauses"],
+        ),
         (
             "myth_solver_rewrite_bits_total",
             (("stage", "before"),),

@@ -128,4 +128,14 @@ MYTHRIL_TPU_MESH=on python -m pytest tests/laser/test_mesh_fused.py \
     -q -p no:cacheprovider \
     -k "steal or tier or planned"
 
+echo "== in-loop solve fast tests =="
+# the in-loop propagation kernel on a tiny CNF: R1/R3 contradiction
+# masks, clause-pool unit propagation, the solver_cache pool round-trip
+# (note_path_literal -> build_inloop_pool), and a one-lane fused run
+# with with_solve on. Pure CPU jit, seconds. The ON/OFF equivalence
+# property tests over the bench contracts run with the full suite.
+python -m pytest tests/laser/test_inloop_solve.py \
+    -q -p no:cacheprovider \
+    -k "not equivalence and not mesh"
+
 echo "ALL CHECKS PASSED"

@@ -345,6 +345,7 @@ _LANE_PLANES = {
 _LANE_INDEX_ALLOWED = {
     "mythril_tpu/laser/tpu/batch.py",
     "mythril_tpu/laser/tpu/engine.py",
+    "mythril_tpu/laser/tpu/inloop_solve.py",
     "mythril_tpu/laser/tpu/symtape.py",
     "mythril_tpu/laser/tpu/bridge.py",
     "mythril_tpu/laser/tpu/transfer.py",
@@ -393,13 +394,22 @@ _SOLVER_ENTRYPOINTS = {
     "check_batch",
     "solve_checked",
     "IncrementalCore",
+    # in-loop pool constructors (laser/tpu/inloop_solve.py): pool
+    # CONTENT is a soundness input — every clause must be the negation
+    # of a host-proved UNSAT set — so only solver_cache may assemble
+    # one (build_inloop_pool); anything else could feed the device
+    # kernel unproved clauses and turn the screen into an oracle
+    "make_pool",
+    "empty_pool",
 }
 
 # Modules allowed to touch solver entrypoints: the smt layer that OWNS
-# them, and the two boundary modules.
+# them, and the boundary modules (inloop_solve.py owns make_pool/
+# empty_pool the same way solver_jax owns check_batch).
 _SOLVER_BOUNDARY_ALLOWED = {
     "mythril_tpu/laser/tpu/solver_jax.py",
     "mythril_tpu/laser/tpu/solver_cache.py",
+    "mythril_tpu/laser/tpu/inloop_solve.py",
 }
 
 
@@ -568,6 +578,7 @@ def metric_names(tree: ast.AST, source: str, rel: str):
 # helpers in the same file take a noqa.
 _DEVICE_PURE_FILES = {
     "mythril_tpu/laser/tpu/engine.py",
+    "mythril_tpu/laser/tpu/inloop_solve.py",
     "mythril_tpu/laser/tpu/megakernel.py",
     "mythril_tpu/laser/tpu/mesh.py",
 }

@@ -2,7 +2,10 @@
 
 Mirrors the reference's reliance on a known-good keccak
 (mythril/support/support_utils.py:4); the device kernel must agree
-byte-for-byte on every input length across block boundaries.
+byte-for-byte on every input length across block boundaries. The cap is
+driven by ``engine.SHA_CAP`` — the longest preimage the device hashes
+(ISSUE 19 routes symbolic storage-key preimages through this kernel, so
+the sweep must cover everything the engine can feed it).
 """
 
 import random
@@ -10,19 +13,23 @@ import random
 import numpy as np
 import jax.numpy as jnp
 
+from mythril_tpu.laser.tpu.engine import SHA_CAP
 from mythril_tpu.laser.tpu.keccak_tpu import keccak256_batch
 from mythril_tpu.support.keccak import keccak256
 
 
 def test_keccak256_batch_matches_host():
     random.seed(7)
-    cases = [b"", b"abc", b"a" * 135, b"a" * 136, b"a" * 137, b"a" * 271, b"a" * 272]
+    # every rate-block boundary the engine can reach (rate = 136 bytes),
+    # plus/minus one byte, up to the device cap itself
+    boundaries = [0, 1, 135, 136, 137, 271, 272, 273, 407, 408, 409,
+                  543, SHA_CAP]
+    cases = [b"abc"] + [b"a" * n for n in boundaries]
     cases += [
-        bytes(random.randrange(256) for _ in range(random.randrange(0, 290)))
+        bytes(random.randrange(256) for _ in range(random.randrange(0, SHA_CAP + 1)))
         for _ in range(24)
     ]
-    cap = 300
-    data = np.zeros((len(cases), cap), dtype=np.uint8)
+    data = np.zeros((len(cases), SHA_CAP), dtype=np.uint8)
     lens = np.zeros(len(cases), dtype=np.int32)
     for i, c in enumerate(cases):
         data[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
@@ -30,6 +37,17 @@ def test_keccak256_batch_matches_host():
     out = np.asarray(keccak256_batch(jnp.asarray(data), jnp.asarray(lens)))
     for i, c in enumerate(cases):
         assert bytes(out[i]) == keccak256(c), (i, len(c))
+
+
+def test_keccak256_batch_all_lanes_empty():
+    # the fused loop hashes a whole batch unconditionally; the all-empty
+    # batch (no symbolic SHA3 anywhere) must still be byte-correct
+    data = np.zeros((5, 64), dtype=np.uint8)
+    lens = np.zeros(5, dtype=np.int32)
+    out = np.asarray(keccak256_batch(jnp.asarray(data), jnp.asarray(lens)))
+    want = keccak256(b"")
+    for i in range(5):
+        assert bytes(out[i]) == want
 
 
 def test_keccak256_batch_2d_batch_shape():

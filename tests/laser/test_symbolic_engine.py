@@ -438,6 +438,109 @@ def test_small_slot_entries_do_not_block_sym_probe():
     assert int(np.asarray(out.status)[0]) == STOPPED
 
 
+def test_sstore_sym_keccak_vs_big_concrete_entry_traps():
+    """Satellite pin (ISSUE 19): the SSTORE direction of the aliasing
+    guard. A concrete entry at a keccak-image key (>= 2^128) can alias
+    a symbolic keccak write target, so the store must leave the device
+    model — the digest rework resolves symbolic-vs-symbolic probes but
+    must NOT weaken this concrete-entry guard."""
+    from mythril_tpu.support.keccak import keccak256
+
+    conc_key = int.from_bytes(
+        keccak256((0x41).to_bytes(32, "big") + (1).to_bytes(32, "big")), "big"
+    )
+    src = """
+    CALLER
+    PUSH1 0x00
+    MSTORE
+    PUSH1 0x01
+    PUSH1 0x20
+    MSTORE
+    PUSH1 0x40
+    PUSH1 0x00
+    SHA3
+    PUSH1 0x07
+    SWAP1
+    SSTORE
+    STOP
+    """
+    out = run_src(
+        src,
+        spec=dict(symbolic_caller=True, storage={conc_key: 7}),
+        cfg=small_cfg(lanes=4, tape_slots=64),
+    )
+    assert int(np.asarray(out.status)[0]) == TRAP
+    assert int(np.asarray(out.trap_op)[0]) == 0x55  # SSTORE
+
+
+ADD_FORM_SRC = """
+CALLER
+PUSH1 0x00
+MSTORE
+PUSH1 0x01
+PUSH1 0x20
+MSTORE
+PUSH1 0x40
+PUSH1 0x00
+SHA3
+PUSH1 {off1}
+ADD
+PUSH1 0x07
+SWAP1
+SSTORE
+CALLER
+PUSH1 0x00
+MSTORE
+PUSH1 0x01
+PUSH1 0x20
+MSTORE
+PUSH1 0x40
+PUSH1 0x00
+SHA3
+PUSH1 {off2}
+ADD
+SLOAD
+PUSH2 :x
+JUMPI
+STOP
+x:
+JUMPDEST
+STOP
+"""
+
+
+def test_addform_mapping_key_resolves_on_device():
+    # struct-field slot keccak(...)+1: before ISSUE 19 any non-SHA3
+    # symbolic key froze the lane at the SSTORE; the digest probe now
+    # resolves it in the resident storage plane and the readback hits
+    # the same entry (concrete 7 -> no fork)
+    out = run_src(
+        ADD_FORM_SRC.format(off1="0x01", off2="0x01"),
+        spec=dict(symbolic_caller=True, symbolic_storage=True),
+        cfg=small_cfg(lanes=4, tape_slots=64),
+    )
+    status = np.asarray(out.status)
+    assert np.asarray(out.alive).sum() == 1
+    assert status[0] == STOPPED
+    assert read_path(out, 0) == []
+
+
+def test_addform_distinct_offsets_do_not_alias():
+    # keccak(...)+1 written, keccak(...)+2 probed: distinct digests must
+    # MISS (fresh symbolic leaf -> the JUMPI forks), never unify
+    out = run_src(
+        ADD_FORM_SRC.format(off1="0x01", off2="0x02"),
+        spec=dict(symbolic_caller=True, symbolic_storage=True),
+        cfg=small_cfg(lanes=4, tape_slots=64),
+    )
+    alive = np.asarray(out.alive)
+    status = np.asarray(out.status)
+    assert alive.sum() == 2
+    assert (status[:2] == STOPPED).all()
+    tape = read_tape(out, 0)
+    assert any(t[0] == symtape.OP_SLOAD for t in tape)
+
+
 def test_gas_spent_max_exceeds_min_on_symbolic_sstore():
     src = """
     CALLER
